@@ -1,0 +1,43 @@
+"""Test bootstrap: simulate an 8-device mesh on CPU.
+
+The reference simulates a cluster by forking processes over loopback
+(SURVEY.md §4.2, train_dist.py:138-147).  Our analog is
+``--xla_force_host_platform_device_count=8``: eight XLA CPU devices in one
+process, meshed exactly like TPU chips.  The flag must land before JAX
+initializes its backends, hence this top-of-conftest env mutation.
+
+Tests always build meshes from explicit CPU devices (``platform='cpu'``) so
+they never touch a real TPU (which may be a slow tunnel in CI).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ.setdefault("TPU_DIST_PLATFORM", "cpu")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Restrict JAX to the CPU platform entirely: initializing the TPU backend
+# in a test run is both slow (tunneled) and unnecessary, and the axon shim
+# ignores the JAX_PLATFORMS env var (it rewrites platform selection at
+# interpreter startup) — the config override below still wins because no
+# backend has been initialized yet at conftest-import time.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected >=8 simulated CPU devices, got {len(devs)}"
+    return devs
+
+
+def spmd_run(fn, *args, world=8):
+    """Shared helper: run rank-style fn on the simulated CPU mesh."""
+    from tpu_dist import comm
+
+    return comm.spmd(fn, *args, world=world, platform="cpu")

@@ -1,0 +1,181 @@
+"""Known-answer collective tests — the reference's core verification idea
+(SURVEY.md §4.1: each demo prints a value computable by hand) promoted to a
+real test suite, on the simulated 8-device mesh."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import spmd_run as run
+from tpu_dist import comm
+
+N = 8
+
+
+class TestReduceOps:
+    """all_reduce over WORLD with the four reduce_ops (tuto.md:190-193)."""
+
+    def test_sum_of_ones_is_world_size(self):
+        # tuto.md:184-185 known answer: all_reduce(ones, SUM) -> world size.
+        out = run(lambda: comm.all_reduce(jnp.ones(())))
+        np.testing.assert_allclose(out, np.full(N, N))
+
+    def test_sum_matches_numpy(self):
+        def fn():
+            x = (comm.rank() + 1).astype(jnp.float32)
+            return comm.all_reduce(x, comm.ReduceOp.SUM)
+
+        np.testing.assert_allclose(run(fn), np.full(N, N * (N + 1) / 2))
+
+    def test_product(self):
+        def fn():
+            x = (comm.rank() + 1).astype(jnp.float32)
+            return comm.all_reduce(x, comm.ReduceOp.PRODUCT)
+
+        import math
+
+        np.testing.assert_allclose(run(fn), np.full(N, float(math.factorial(N))))
+
+    def test_max_min(self):
+        def fn():
+            x = comm.rank().astype(jnp.float32)
+            return (
+                comm.all_reduce(x, comm.ReduceOp.MAX),
+                comm.all_reduce(x, comm.ReduceOp.MIN),
+            )
+
+        mx, mn = run(fn)
+        np.testing.assert_allclose(mx, np.full(N, N - 1))
+        np.testing.assert_allclose(mn, np.zeros(N))
+
+    def test_int_dtype(self):
+        def fn():
+            x = comm.rank() + 1
+            return comm.all_reduce(x, comm.ReduceOp.MAX)
+
+        np.testing.assert_array_equal(run(fn), np.full(N, N))
+
+
+class TestGroups:
+    """Sub-group collectives — dist.new_group (tuto.md:178-186)."""
+
+    def test_group_allreduce_known_answer(self):
+        # tuto.md:178-186: new_group([0,1]); all_reduce(ones) -> 2 on
+        # members; non-members keep their input (don't participate).
+        g = comm.new_group([0, 1])
+
+        def fn():
+            return comm.all_reduce(jnp.ones(()), comm.ReduceOp.SUM, group=g)
+
+        out = np.asarray(run(fn))
+        np.testing.assert_allclose(out[:2], [2.0, 2.0])
+        np.testing.assert_allclose(out[2:], np.ones(N - 2))
+
+    def test_odd_sized_group_max(self):
+        g = comm.new_group([1, 4, 6])
+
+        def fn():
+            x = comm.rank().astype(jnp.float32)
+            return comm.all_reduce(x, comm.ReduceOp.MAX, group=g)
+
+        out = np.asarray(run(fn))
+        expect = np.arange(N, dtype=np.float32)
+        expect[[1, 4, 6]] = 6.0
+        np.testing.assert_allclose(out, expect)
+
+
+class TestDataMovement:
+    def test_broadcast(self):
+        def fn():
+            x = comm.rank().astype(jnp.float32) * 10.0
+            return comm.broadcast(x, src=3)
+
+        np.testing.assert_allclose(run(fn), np.full(N, 30.0))
+
+    def test_all_gather(self):
+        def fn():
+            x = comm.rank().astype(jnp.float32).reshape(1)
+            return comm.all_gather(x)
+
+        out = np.asarray(run(fn))  # (N, N, 1)
+        for r in range(N):
+            np.testing.assert_allclose(out[r, :, 0], np.arange(N))
+
+    def test_gather_root_gets_stack_others_zero(self):
+        # ptp.py:21-28 demo: every rank contributes ones(1); root's
+        # sum over the gather list == world size.
+        def fn():
+            return comm.gather(jnp.ones(1), dst=0)
+
+        out = np.asarray(run(fn))  # (N, N, 1)
+        assert out[0].sum() == N
+        np.testing.assert_allclose(out[1:], 0.0)
+
+    def test_scatter(self):
+        def fn():
+            xs = jnp.arange(N, dtype=jnp.float32) * (comm.rank() + 1)
+            return comm.scatter(xs, src=2)
+
+        out = np.asarray(run(fn))
+        # every rank r receives chunk r of src(=2)'s array: 3*r
+        np.testing.assert_allclose(out, 3.0 * np.arange(N))
+
+    def test_reduce_root_only(self):
+        def fn():
+            return comm.reduce(jnp.ones(()), dst=5)
+
+        out = np.asarray(run(fn))
+        expect = np.ones(N)
+        expect[5] = N
+        np.testing.assert_allclose(out, expect)
+
+
+class TestPointToPoint:
+    def test_blocking_send_recv_ping(self):
+        # tuto.md:79-97 known answer: rank 0 sends tensor+1; both ranks
+        # end with 1.0.
+        def fn():
+            t = jnp.zeros(1)
+            t = jnp.where(comm.rank() == 0, t + 1, t)
+            return comm.send(t, dst=1, src=0)
+
+        out = np.asarray(run(fn, world=2))
+        np.testing.assert_allclose(out, np.ones((2, 1)))
+
+    def test_ping_pong_round_trip(self):
+        # BASELINE.json config 1: 2-rank ping-pong; value accumulates
+        # +1 per hop on rank 0's schedule.
+        def fn():
+            t = jnp.zeros(())
+            t = comm.send(jnp.where(comm.rank() == 0, t + 1, t), dst=1, src=0)
+            t = comm.send(jnp.where(comm.rank() == 1, t + 1, t), dst=0, src=1)
+            return t
+
+        out = np.asarray(run(fn, world=2))
+        np.testing.assert_allclose(out, [2.0, 2.0])
+
+    def test_shift_ring(self):
+        def fn():
+            return comm.shift(comm.rank().astype(jnp.float32), 1)
+
+        out = np.asarray(run(fn))
+        np.testing.assert_allclose(out, (np.arange(N) - 1) % N)
+
+    def test_sendrecv_perm(self):
+        def fn():
+            return comm.sendrecv(
+                comm.rank().astype(jnp.float32), [(0, 7), (7, 0)]
+            )
+
+        out = np.asarray(run(fn))
+        assert out[7] == 0.0 and out[0] == 7.0
+        np.testing.assert_allclose(out[1:7], 0.0)
+
+
+def test_rank_world_size():
+    def fn():
+        return comm.rank(), jnp.zeros(()) + comm.world_size()
+
+    r, w = run(fn)
+    np.testing.assert_array_equal(np.asarray(r), np.arange(N))
+    np.testing.assert_allclose(w, np.full(N, N))

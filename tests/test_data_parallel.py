@@ -1,0 +1,77 @@
+"""Data-parallel train step: gradient averaging correctness and
+cross-replica parameter identity — the invariants of train_dist.py
+(SURVEY.md §2c.6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tests.conftest import spmd_run as run
+from tpu_dist import comm, parallel, train
+
+
+def _quadratic_loss(params, batch, key):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2), {}
+
+
+def test_average_gradients_is_pmean():
+    def fn():
+        g = {"w": jnp.ones((2,)) * (comm.rank() + 1.0)}
+        return parallel.average_gradients(g, comm.DEFAULT_AXIS)
+
+    out = run(fn, world=4)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full((4, 2), 2.5))
+
+
+def test_train_step_matches_single_device_global_batch():
+    """DP over 8 shards must equal single-device training on the global
+    batch (the defining property of synchronous data-parallel SGD)."""
+    mesh = comm.make_mesh(8, ("data",), platform="cpu")
+    opt = train.sgd(0.1, momentum=0.5)
+    step = parallel.make_train_step(_quadratic_loss, opt, mesh, donate=False)
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (16, 3))
+    w_true = jnp.array([[1.0], [-2.0], [0.5]])
+    y = x @ w_true
+    params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+    opt_state = opt.init(params)
+
+    p_mesh = parallel.replicate(params, mesh)
+    s_mesh = jax.tree.map(
+        lambda l: parallel.replicate(l, mesh) if hasattr(l, "shape") else l,
+        opt_state,
+    )
+    batch = parallel.shard_batch((x, y), mesh)
+
+    losses = []
+    for i in range(5):
+        p_mesh, s_mesh, loss, _ = step(p_mesh, s_mesh, batch, jax.random.key(1))
+        losses.append(float(loss))
+
+    # single-device reference on the global batch
+    p_ref, s_ref = params, opt_state
+    for i in range(5):
+        (l, _), g = jax.value_and_grad(_quadratic_loss, has_aux=True)(
+            p_ref, (x, y), jax.random.key(1)
+        )
+        p_ref, s_ref = opt.update(p_ref, g, s_ref)
+
+    np.testing.assert_allclose(
+        np.asarray(p_mesh["w"]), np.asarray(p_ref["w"]), rtol=1e-5, atol=1e-6
+    )
+    assert losses[-1] < losses[0], "loss must decrease"
+
+
+def test_torch_momentum_semantics():
+    """buf = m*buf + g; p -= lr*buf (no dampening) — two steps by hand."""
+    opt = train.sgd(0.5, momentum=0.5)
+    p = {"w": jnp.array([1.0])}
+    s = opt.init(p)
+    g = {"w": jnp.array([1.0])}
+    p, s = opt.update(p, g, s)  # buf=1, p=1-0.5=0.5
+    np.testing.assert_allclose(np.asarray(p["w"]), [0.5])
+    p, s = opt.update(p, g, s)  # buf=1.5, p=0.5-0.75=-0.25
+    np.testing.assert_allclose(np.asarray(p["w"]), [-0.25])

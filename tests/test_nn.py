@@ -1,0 +1,108 @@
+"""Unit tests for the nn layer library (the torch.nn-role components)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist import nn
+
+
+def test_dense_shapes_and_linearity():
+    layer = nn.Dense(5)
+    params, state = layer.init(jax.random.key(0), (3,))
+    x = jnp.ones((4, 3))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (4, 5)
+    y2, _ = layer.apply(params, state, 2 * x)
+    np.testing.assert_allclose(2 * (y - params["b"]), y2 - params["b"], rtol=1e-5)
+
+
+def test_conv_shape_inference_matches_apply():
+    layer = nn.Conv2D(7, 5)
+    params, state = layer.init(jax.random.key(0), (28, 28, 1))
+    assert layer.out_shape((28, 28, 1)) == (24, 24, 7)
+    y, _ = layer.apply(params, state, jnp.ones((2, 28, 28, 1)))
+    assert y.shape == (2, 24, 24, 7)
+
+
+def test_maxpool():
+    layer = nn.MaxPool2D(2)
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = layer.apply({}, {}, x)
+    np.testing.assert_allclose(y[0, :, :, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_dropout_train_vs_eval():
+    layer = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y_eval, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_eval), np.asarray(x))
+    y_train, _ = layer.apply({}, {}, x, train=True, key=jax.random.key(0))
+    kept = float((np.asarray(y_train) > 0).mean())
+    assert 0.45 < kept < 0.55
+    np.testing.assert_allclose(np.asarray(y_train)[np.asarray(y_train) > 0], 2.0)
+
+
+def test_dropout2d_drops_whole_channels():
+    layer = nn.Dropout2D(0.5)
+    x = jnp.ones((4, 8, 8, 32))
+    y, _ = layer.apply({}, {}, x, train=True, key=jax.random.key(1))
+    y = np.asarray(y)
+    per_channel = y.reshape(4, 64, 32)
+    for b in range(4):
+        for c in range(32):
+            vals = np.unique(per_channel[b, :, c])
+            assert len(vals) == 1, "channel must be uniformly kept or dropped"
+
+
+def test_batchnorm_normalizes_and_tracks_stats():
+    layer = nn.BatchNorm()
+    params, state = layer.init(jax.random.key(0), (4,))
+    x = jax.random.normal(jax.random.key(2), (256, 4)) * 3.0 + 5.0
+    y, new_state = layer.apply(params, state, x, train=True)
+    np.testing.assert_allclose(np.asarray(y.mean(0)), np.zeros(4), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y.std(0)), np.ones(4), atol=1e-2)
+    assert not np.allclose(np.asarray(new_state["mean"]), 0.0)
+
+
+def test_layernorm():
+    layer = nn.LayerNorm()
+    params, state = layer.init(jax.random.key(0), (8,))
+    x = jax.random.normal(jax.random.key(3), (5, 8)) * 4 + 2
+    y, _ = layer.apply(params, state, x)
+    np.testing.assert_allclose(np.asarray(y.mean(-1)), np.zeros(5), atol=1e-5)
+
+
+def test_mha_shapes_and_causality():
+    layer = nn.MultiHeadAttention(16, 4, causal=True)
+    params, state = layer.init(jax.random.key(0), (6, 16))
+    x = jax.random.normal(jax.random.key(4), (2, 6, 16))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (2, 6, 16)
+    # causality: output at position 0 must not change if later tokens change
+    x2 = x.at[:, 3:].set(0.0)
+    y2, _ = layer.apply(params, state, x2)
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(y2[:, 0]), atol=1e-6)
+
+
+def test_losses_known_values():
+    logp = jnp.log(jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    targets = jnp.array([0, 1])
+    loss = nn.nll_loss(logp, targets)
+    np.testing.assert_allclose(
+        float(loss), -(np.log(0.7) + np.log(0.8)) / 2, rtol=1e-6
+    )
+    assert float(nn.accuracy(logp, targets)) == 1.0
+
+
+def test_sequential_threads_state():
+    net = nn.Sequential([nn.Dense(4), nn.BatchNorm(), nn.relu(), nn.Dense(2)])
+    params, state = net.init(jax.random.key(0), (3,))
+    x = jax.random.normal(jax.random.key(5), (10, 3))
+    y, new_state = net.apply(params, state, x, train=True)
+    assert y.shape == (10, 2)
+    # BatchNorm state (index 1) must have been updated
+    assert not np.allclose(
+        np.asarray(new_state[1]["mean"]), np.asarray(state[1]["mean"])
+    )

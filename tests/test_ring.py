@@ -1,0 +1,92 @@
+"""Ring allreduce cross-checked against lax.psum — the north-star parity
+requirement (BASELINE.md): the hand-rolled ring (allreduce.py:8-34, done
+*correctly* per SURVEY.md §2c.1) must agree with the built-in collective."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import spmd_run as run
+from tpu_dist import comm, parallel
+
+N = 8
+
+
+def _rank_tensor(shape):
+    r = comm.rank().astype(jnp.float32)
+    base = jnp.arange(np.prod(shape), dtype=jnp.float32).reshape(shape)
+    return base * 0.01 + r + 1.0
+
+
+@pytest.mark.parametrize("shape", [(4,), (2, 2), (5, 3), (1,)])
+def test_naive_ring_matches_psum(shape):
+    def fn():
+        x = _rank_tensor(shape)
+        return parallel.ring_all_reduce(x), comm.all_reduce(x)
+
+    ring, psum = run(fn)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(psum), rtol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(16,), (2, 2), (7,), (3, 5), (1,), (64, 3)])
+def test_chunked_ring_matches_psum(shape):
+    """Includes sizes not divisible by world size (padding path)."""
+
+    def fn():
+        x = _rank_tensor(shape)
+        return parallel.ring_all_reduce_chunked(x), comm.all_reduce(x)
+
+    ring, psum = run(fn)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(psum), rtol=1e-5)
+
+
+def test_reduce_scatter_ownership():
+    """Rank r ends with fully-reduced chunk (r+1) % n."""
+
+    def fn():
+        x = jnp.arange(16, dtype=jnp.float32) + comm.rank()
+        return parallel.ring_reduce_scatter(x)
+
+    out = np.asarray(run(fn))  # (N, 2)
+    full = np.stack([np.arange(16, dtype=np.float32) + r for r in range(N)]).sum(0)
+    for r in range(N):
+        c = (r + 1) % N
+        np.testing.assert_allclose(out[r], full[2 * c : 2 * c + 2])
+
+
+def test_ring_all_gather():
+    def fn():
+        chunk = comm.rank().astype(jnp.float32).reshape(1) * 2.0
+        return parallel.ring_all_gather(chunk)
+
+    out = np.asarray(run(fn))  # (N, N, 1)
+    for r in range(N):
+        np.testing.assert_allclose(out[r, :, 0], 2.0 * np.arange(N))
+
+
+def test_allreduce_driver_known_answer():
+    """allreduce.py:37-47 semantics: 4 iterations of t = all_reduce(t) over
+    n ranks multiplies by n each time -> t_final = n^4 * t0; with t0 = ones
+    on every rank the known answer is n^4."""
+
+    def fn():
+        t = jnp.ones((2, 2))
+        for _ in range(4):
+            t = parallel.ring_all_reduce_chunked(t)
+        return t
+
+    out = np.asarray(run(fn, world=4))
+    np.testing.assert_allclose(out, np.full((4, 2, 2), 4.0**4))
+
+
+def test_world_size_one():
+    def fn():
+        x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+        return (
+            parallel.ring_all_reduce(x),
+            parallel.ring_all_reduce_chunked(x),
+        )
+
+    a, b = run(fn, world=1)
+    np.testing.assert_allclose(np.asarray(a)[0], np.arange(6).reshape(2, 3))
+    np.testing.assert_allclose(np.asarray(b)[0], np.arange(6).reshape(2, 3))

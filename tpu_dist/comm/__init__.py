@@ -1,0 +1,58 @@
+"""`tpu_dist.comm` — communication core (L0-L2 of SURVEY.md §1).
+
+Mesh construction (process-group analog), collectives over mesh axes, p2p
+via ppermute, sub-groups, and process bootstrap.
+"""
+
+from tpu_dist.comm.collectives import (
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    barrier,
+    broadcast,
+    gather,
+    new_group,
+    rank,
+    reduce,
+    scatter,
+    send,
+    sendrecv,
+    shift,
+    world_size,
+)
+from tpu_dist.comm.init import (
+    InitConfig,
+    init,
+    process_count,
+    process_rank,
+)
+from tpu_dist.comm.mesh import DEFAULT_AXIS, devices, make_mesh, world_mesh
+from tpu_dist.comm.runner import spmd
+
+__all__ = [
+    "DEFAULT_AXIS",
+    "Group",
+    "InitConfig",
+    "ReduceOp",
+    "all_gather",
+    "all_reduce",
+    "barrier",
+    "broadcast",
+    "devices",
+    "gather",
+    "init",
+    "make_mesh",
+    "new_group",
+    "process_count",
+    "process_rank",
+    "rank",
+    "reduce",
+    "scatter",
+    "send",
+    "sendrecv",
+    "shift",
+    "spmd",
+    "world_mesh",
+    "world_size",
+]

@@ -1,0 +1,290 @@
+"""The collective-communication API — TPU-native rebuild of the tutorial's
+``torch.distributed`` surface.
+
+Every call here is designed to be used *inside* SPMD code (under
+``shard_map`` over a mesh axis, see `tpu_dist.comm.runner.spmd`): each
+program instance is the analog of one reference "rank", and the collectives
+lower to XLA HLO collectives (AllReduce, AllGather, CollectivePermute) that
+ride ICI between chips — compiled into the program, not interpreted per-call
+the way THD dispatches each ``dist.*`` invocation (tuto.md:404-419).
+
+Coverage of the reference API catalog (tuto.md:176-202):
+
+- ``all_reduce`` with ``ReduceOp.{SUM, PRODUCT, MAX, MIN}``
+  (reduce_op enum, tuto.md:190-193)
+- ``reduce`` (root semantics are post-hoc on a symmetric collective —
+  TPU collectives have no privileged root)
+- ``broadcast``, ``scatter``, ``gather``, ``all_gather``
+- sub-groups via ``new_group`` (tuto.md:178-186)
+- point-to-point ``send``/``shift``/``sendrecv`` over ``lax.ppermute``
+  (tuto.md:79-121); blocking semantics are native — an SPMD program is
+  lockstep by construction, and "immediate" isend/irecv maps to XLA's
+  async dispatch with data-flow ordering playing the role of ``wait()``.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.comm.mesh import DEFAULT_AXIS
+
+
+class ReduceOp(enum.Enum):
+    """The four reduction ops the tutorial teaches (tuto.md:190-193)."""
+
+    SUM = "sum"
+    PRODUCT = "product"
+    MAX = "max"
+    MIN = "min"
+
+
+@dataclass(frozen=True)
+class Group:
+    """A communication sub-group — ``dist.new_group([ranks])`` analog.
+
+    The reference builds groups as subsets of WORLD (tuto.md:178-186).
+    XLA's ``axis_index_groups`` requires equal-size groups partitioning the
+    axis, so arbitrary subsets use a gather-and-mask path instead: members
+    reduce over member contributions only; non-members pass their input
+    through unchanged (matching torch, where non-members don't participate).
+    """
+
+    ranks: tuple[int, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "ranks", tuple(sorted(set(self.ranks))))
+
+    def is_member(self, axis_name: str = DEFAULT_AXIS):
+        return jnp.isin(lax.axis_index(axis_name), jnp.array(self.ranks))
+
+    def mask(self, n: int) -> jnp.ndarray:
+        return jnp.isin(jnp.arange(n), jnp.array(self.ranks))
+
+
+def new_group(ranks: Sequence[int]) -> Group:
+    """``dist.new_group(ranks)`` analog (tuto.md:180)."""
+    return Group(tuple(ranks))
+
+
+def rank(axis_name: str = DEFAULT_AXIS) -> jax.Array:
+    """``dist.get_rank()`` inside SPMD code."""
+    return lax.axis_index(axis_name)
+
+
+def world_size(axis_name: str = DEFAULT_AXIS) -> int:
+    """``dist.get_world_size()`` inside SPMD code (static under trace)."""
+    return lax.axis_size(axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Reductions
+# ---------------------------------------------------------------------------
+
+_IDENTITY = {
+    ReduceOp.SUM: 0.0,
+    ReduceOp.PRODUCT: 1.0,
+    ReduceOp.MAX: -jnp.inf,
+    ReduceOp.MIN: jnp.inf,
+}
+
+
+def _masked_identity(op: ReduceOp, dtype) -> jax.Array:
+    ident = _IDENTITY[op]
+    if jnp.issubdtype(dtype, jnp.integer):
+        info = jnp.iinfo(dtype)
+        ident = {
+            ReduceOp.SUM: 0,
+            ReduceOp.PRODUCT: 1,
+            ReduceOp.MAX: info.min,
+            ReduceOp.MIN: info.max,
+        }[op]
+    return jnp.asarray(ident, dtype)
+
+
+def _reduce_stacked(stacked: jax.Array, op: ReduceOp) -> jax.Array:
+    if op is ReduceOp.SUM:
+        return stacked.sum(axis=0)
+    if op is ReduceOp.PRODUCT:
+        return stacked.prod(axis=0)
+    if op is ReduceOp.MAX:
+        return stacked.max(axis=0)
+    if op is ReduceOp.MIN:
+        return stacked.min(axis=0)
+    raise ValueError(f"unknown op {op}")
+
+
+def all_reduce(
+    x: jax.Array,
+    op: ReduceOp = ReduceOp.SUM,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    group: Group | None = None,
+) -> jax.Array:
+    """``dist.all_reduce(tensor, op, group)`` (tuto.md:182-186).
+
+    WORLD reductions lower directly to XLA AllReduce (psum/pmax/pmin);
+    PRODUCT (no XLA primitive) and sub-group reductions take an
+    all-gather + on-device reduction.  Known answer: all_reduce of ones
+    over n ranks with SUM prints n (tuto.md:184-185).
+    """
+    if group is None:
+        if op is ReduceOp.SUM:
+            return lax.psum(x, axis_name)
+        if op is ReduceOp.MAX:
+            return lax.pmax(x, axis_name)
+        if op is ReduceOp.MIN:
+            return lax.pmin(x, axis_name)
+        stacked = lax.all_gather(x, axis_name, axis=0)
+        return _reduce_stacked(stacked, op)
+    n = lax.axis_size(axis_name)
+    if group.ranks and not (0 <= min(group.ranks) and max(group.ranks) < n):
+        raise ValueError(
+            f"group ranks {group.ranks} out of range for world size {n}"
+        )
+    stacked = lax.all_gather(x, axis_name, axis=0)
+    mask = group.mask(n).reshape((n,) + (1,) * x.ndim)
+    ident = _masked_identity(op, stacked.dtype)
+    reduced = _reduce_stacked(jnp.where(mask, stacked, ident), op)
+    return jnp.where(group.is_member(axis_name), reduced, x)
+
+
+def reduce(
+    x: jax.Array,
+    dst: int,
+    op: ReduceOp = ReduceOp.SUM,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    group: Group | None = None,
+) -> jax.Array:
+    """``dist.reduce(tensor, dst, op)`` — result stored at dst only
+    (tuto.md:196).  TPU collectives are symmetric; "root" is a post-hoc
+    select: dst receives the reduction, other ranks keep their input
+    (torch leaves non-dst buffers unspecified; passthrough is our defined
+    behavior).
+    """
+    reduced = all_reduce(x, op, axis_name, group=group)
+    return jnp.where(lax.axis_index(axis_name) == dst, reduced, x)
+
+
+# ---------------------------------------------------------------------------
+# Data movement
+# ---------------------------------------------------------------------------
+
+
+def broadcast(
+    x: jax.Array, src: int, axis_name: str = DEFAULT_AXIS
+) -> jax.Array:
+    """``dist.broadcast(tensor, src)`` (tuto.md:195): all ranks end with
+    src's value.  Implemented as a masked AllReduce (multicast is not a
+    permutation, so ppermute can't express it; XLA fuses the mask).
+    """
+    contrib = jnp.where(lax.axis_index(axis_name) == src, x, jnp.zeros_like(x))
+    return lax.psum(contrib, axis_name)
+
+
+def all_gather(
+    x: jax.Array, axis_name: str = DEFAULT_AXIS, *, axis: int = 0, tiled: bool = False
+) -> jax.Array:
+    """``dist.all_gather(tensor_list, tensor)`` (tuto.md:199): every rank
+    receives the stacked contributions (shape ``(n, ...)`` on a new leading
+    axis by default)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def gather(
+    x: jax.Array, dst: int, axis_name: str = DEFAULT_AXIS
+) -> jax.Array:
+    """``dist.gather(tensor, dst, gather_list)`` (tuto.md:198; demoed at
+    ptp.py:21-28): dst receives the stack of all contributions; other ranks
+    receive zeros (torch gives them nothing — SPMD outputs are uniform, so
+    "nothing" is zeros)."""
+    stacked = lax.all_gather(x, axis_name, axis=0)
+    return jnp.where(lax.axis_index(axis_name) == dst, stacked, jnp.zeros_like(stacked))
+
+
+def scatter(
+    xs: jax.Array, src: int, axis_name: str = DEFAULT_AXIS
+) -> jax.Array:
+    """``dist.scatter(tensor, src, scatter_list)`` (tuto.md:197): src's i-th
+    chunk (leading axis) lands on rank i.  Only src's ``xs`` matters; it is
+    broadcast (chips share ICI bandwidth; XLA may optimize to a true
+    scatter) and each rank slices its own chunk."""
+    n = lax.axis_size(axis_name)
+    if xs.shape[0] != n:
+        raise ValueError(
+            f"scatter needs one leading-axis chunk per rank: got "
+            f"xs.shape[0]={xs.shape[0]} for world size {n} (torch raises on "
+            f"mismatched scatter_list length too)"
+        )
+    from_src = broadcast(xs, src, axis_name)
+    return lax.dynamic_index_in_dim(
+        from_src, lax.axis_index(axis_name), axis=0, keepdims=False
+    )
+
+
+# ---------------------------------------------------------------------------
+# Point-to-point (ppermute) — tuto.md:79-121
+# ---------------------------------------------------------------------------
+
+
+def ring_perm(n: int) -> list[tuple[int, int]]:
+    """The neighbor ring: every rank sends right, receives from left
+    (allreduce.py:18-20).  Shared by `shift`, the ring allreduce, and ring
+    attention so the topology is defined once."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def sendrecv(
+    x: jax.Array,
+    perm: Sequence[tuple[int, int]],
+    axis_name: str = DEFAULT_AXIS,
+) -> jax.Array:
+    """Raw ``lax.ppermute``: each (src, dst) pair delivers src's x to dst;
+    ranks receiving nothing get zeros.  This is the compiled-SPMD form of
+    blocking send/recv (tuto.md:79-97): the collective permute is a
+    lockstep step of the program, so "both processes stop until the
+    communication is completed" holds by construction.
+    """
+    n = lax.axis_size(axis_name)
+    for s, d in perm:
+        if not (0 <= s < n and 0 <= d < n):
+            raise ValueError(
+                f"sendrecv pair ({s}, {d}) out of range for world size {n}"
+            )
+    return lax.ppermute(x, axis_name, perm)
+
+
+def send(
+    x: jax.Array, dst: int, src: int, axis_name: str = DEFAULT_AXIS
+) -> jax.Array:
+    """One ``dist.send(tensor, dst)`` / ``dist.recv(tensor, src)`` pair
+    (tuto.md:85-90) as a single SPMD op: dst receives src's value; every
+    other rank (src included) keeps its input unchanged — send buffers
+    don't change, and non-participants are unaffected."""
+    received = sendrecv(x, [(src, dst)], axis_name)
+    return jnp.where(lax.axis_index(axis_name) == dst, received, x)
+
+
+def shift(
+    x: jax.Array, offset: int = 1, axis_name: str = DEFAULT_AXIS
+) -> jax.Array:
+    """Ring shift: every rank sends to ``(rank + offset) % n`` — the
+    neighbor-exchange pattern of the ring allreduce (allreduce.py:18-20:
+    ``left = (rank-1) % size; right = (rank+1) % size``)."""
+    n = lax.axis_size(axis_name)
+    if offset == 1:
+        return lax.ppermute(x, axis_name, ring_perm(n))
+    return lax.ppermute(x, axis_name, [(i, (i + offset) % n) for i in range(n)])
+
+
+def barrier(axis_name: str = DEFAULT_AXIS) -> None:
+    """``dist.barrier()`` analog. SPMD programs are lockstep at every
+    collective, so this is a documentation-level no-op realized as a tiny
+    psum (forces a synchronization point in the schedule)."""
+    lax.psum(jnp.zeros((), jnp.int32), axis_name)

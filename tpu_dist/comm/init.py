@@ -1,0 +1,104 @@
+"""Process/runtime bootstrap — ``dist.init_process_group`` analog.
+
+The reference's init contract (tuto.md:404-428, exercised at
+train_dist.py:130-135): set ``MASTER_ADDR``/``MASTER_PORT``, call
+``init_process_group(backend, rank, world_size)``; rank 0 acts as master,
+workers rendezvous through it, ending fully connected.  Config comes from
+env vars ``MASTER_PORT/MASTER_ADDR/WORLD_SIZE/RANK`` (tuto.md:421-428).
+
+TPU-native equivalent: ``jax.distributed.initialize(coordinator_address,
+num_processes, process_id)`` — the coordinator is the MASTER_ADDR/PORT
+analog, and the XLA runtime plays THD's role (channel setup, peer
+discovery, collective transport over ICI/DCN).  On a single host (or under
+CPU simulation) no coordinator is needed and init is a no-op, mirroring how
+every reference demo also runs single-machine over loopback (SURVEY.md §4).
+
+The MPI-style rank-less init (``allreduce.py:54`` — rank assigned by
+``mpirun``) maps to TPU pod launch, where process ids come from the
+environment; ``init()`` with no arguments covers it.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+
+
+@dataclass(frozen=True)
+class InitConfig:
+    """Resolved bootstrap configuration (the four env vars of
+    tuto.md:421-428, plus platform as the backend-string analog)."""
+
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+    platform: str | None = None
+
+    @staticmethod
+    def from_env() -> "InitConfig":
+        addr = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT")
+        coordinator = f"{addr}:{port}" if addr and port else None
+        world = os.environ.get("WORLD_SIZE")
+        rank_ = os.environ.get("RANK")
+        return InitConfig(
+            coordinator_address=coordinator,
+            num_processes=int(world) if world is not None else None,
+            process_id=int(rank_) if rank_ is not None else None,
+            platform=os.environ.get("TPU_DIST_PLATFORM"),
+        )
+
+
+_initialized = False
+
+
+def init(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    *,
+    platform: str | None = None,
+) -> InitConfig:
+    """Initialize the distributed runtime.
+
+    Arguments default from the reference's env-var contract
+    (``MASTER_ADDR``/``MASTER_PORT``/``WORLD_SIZE``/``RANK``,
+    tuto.md:421-428).  Single-process (num_processes in (None, 1)): no-op —
+    the runtime is already live.  Multi-process (one process per TPU host):
+    wraps ``jax.distributed.initialize``, the rendezvous of tuto.md:404-419.
+    """
+    global _initialized
+    env = InitConfig.from_env()
+    cfg = InitConfig(
+        coordinator_address=coordinator_address or env.coordinator_address,
+        num_processes=num_processes or env.num_processes,
+        process_id=process_id if process_id is not None else env.process_id,
+        platform=platform or env.platform,
+    )
+    if _initialized:
+        return cfg
+    if cfg.platform is not None:
+        # The backend-string analog ('tcp'/'gloo'/'mpi' → 'cpu'/'tpu'):
+        # restrict JAX to the chosen platform.  Must happen before any
+        # backend initialization to take effect.
+        jax.config.update("jax_platforms", cfg.platform)
+    if cfg.num_processes and cfg.num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+    _initialized = True
+    return cfg
+
+
+def process_rank() -> int:
+    """Host-level ``dist.get_rank()`` (outside SPMD code)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """Host-level ``dist.get_world_size()`` (outside SPMD code)."""
+    return jax.process_count()

@@ -1,0 +1,76 @@
+"""Device-mesh construction — the process-group analog.
+
+The reference's ``init_process_group`` (used at
+/root/reference/train_dist.py:134, ptp.py:34, allreduce.py:54, gloo.py:54)
+establishes a fully-connected group of ``world_size`` ranks over a native
+transport. On TPU the analog is a `jax.sharding.Mesh`: a named arrangement
+of devices over which SPMD programs are compiled and XLA lowers collectives
+onto ICI (intra-slice) / DCN (inter-slice).
+
+Backend plurality ('tcp' / 'gloo' / 'mpi' strings, tuto.md:363-398) maps to
+*platform* selection here: ``platform='tpu'`` for real chips, ``'cpu'`` with
+``--xla_force_host_platform_device_count=N`` for the loopback-fork-style
+simulation the reference uses for development (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DEFAULT_AXIS = "ranks"
+
+
+def devices(platform: str | None = None) -> list[jax.Device]:
+    """All addressable devices, optionally restricted to a platform.
+
+    ``platform=None`` resolves to the default backend (TPU when present).
+    """
+    if platform is None:
+        return list(jax.devices())
+    return list(jax.devices(platform))
+
+
+def make_mesh(
+    shape: int | Sequence[int] | None = None,
+    axis_names: Sequence[str] = (DEFAULT_AXIS,),
+    *,
+    platform: str | None = None,
+    mesh_devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a Mesh — the ``init_process_group`` + group-of-all-ranks analog.
+
+    Args:
+      shape: int (1-D world) or tuple of per-axis sizes. ``None`` uses every
+        device on one axis.
+      axis_names: mesh axis names; collectives address these names (the way
+        reference code addresses ``group=0`` meaning WORLD,
+        train_dist.py:99).
+      platform: 'tpu' | 'cpu' | None (default backend) — the backend-string
+        analog.
+      mesh_devices: explicit device list (overrides platform).
+    """
+    devs = list(mesh_devices) if mesh_devices is not None else devices(platform)
+    if shape is None:
+        shape = (len(devs),) if len(axis_names) == 1 else None
+        if shape is None:
+            raise ValueError("shape required for multi-axis meshes")
+    if isinstance(shape, int):
+        shape = (shape,)
+    shape = tuple(shape)
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(
+            f"mesh shape {shape} needs {n} devices; only {len(devs)} "
+            f"available (platform={platform!r})"
+        )
+    grid = np.array(devs[:n], dtype=object).reshape(shape)
+    return Mesh(grid, tuple(axis_names))
+
+
+def world_mesh(axis_name: str = DEFAULT_AXIS, platform: str | None = None) -> Mesh:
+    """1-D mesh over all devices — WORLD, the reference's default group."""
+    return make_mesh(None, (axis_name,), platform=platform)

@@ -1,0 +1,62 @@
+"""SPMD runner — the process-launcher analog.
+
+The reference forks ``size`` OS processes, each running
+``init_processes(rank, size, fn)`` (train_dist.py:138-147, ptp.py:38-47,
+gloo.py:58-68).  On TPU the "processes" are program instances of one
+compiled SPMD program over a device mesh; ``spmd(fn, ...)`` plays the role
+of the fork-join ``__main__`` template: it wraps rank-style ``fn`` in
+``shard_map`` over a 1-D mesh and returns every rank's result stacked on a
+leading axis (what the reference observes via per-rank ``print``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist.comm.mesh import DEFAULT_AXIS, world_mesh, make_mesh
+
+
+def spmd(
+    fn: Callable[..., Any],
+    *args: Any,
+    world: int | None = None,
+    mesh: Mesh | None = None,
+    axis_name: str = DEFAULT_AXIS,
+    platform: str | None = None,
+    jit: bool = True,
+) -> Any:
+    """Run ``fn(*args)`` as one program instance per mesh device.
+
+    ``fn`` is written rank-style, using `tpu_dist.comm` collectives with
+    ``axis_name``; ``args`` are replicated to every rank (like each forked
+    process constructing the same inputs).  Returns ``fn``'s result pytree
+    with a leading ``(world,)`` axis stacking each rank's value — the
+    analog of collecting every process's prints.
+    """
+    if mesh is None:
+        mesh = (
+            make_mesh(world, (axis_name,), platform=platform)
+            if world is not None
+            else world_mesh(axis_name, platform=platform)
+        )
+
+    def per_rank(*a):
+        out = fn(*a)
+        return jax.tree.map(lambda y: jnp.expand_dims(jnp.asarray(y), 0), out)
+
+    mapped = jax.shard_map(
+        per_rank, mesh=mesh, in_specs=P(), out_specs=P(axis_name), check_vma=False
+    )
+    if jit:
+        mapped = jax.jit(mapped)
+    # Replicate inputs onto the mesh so host arrays land on the right
+    # platform (tests drive a CPU mesh while the default backend is TPU).
+    repl = NamedSharding(mesh, P())
+    args = jax.tree.map(
+        lambda a: jax.device_put(jnp.asarray(a), repl), tuple(args)
+    )
+    return mapped(*args)

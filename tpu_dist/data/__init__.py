@@ -1,0 +1,1 @@
+"""`tpu_dist.data` — see package modules."""

@@ -1,0 +1,1 @@
+"""`tpu_dist.models` — see package modules."""

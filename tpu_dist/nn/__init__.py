@@ -1,0 +1,47 @@
+"""`tpu_dist.nn` — minimal functional module system + layer library."""
+
+from tpu_dist.nn.attention import MultiHeadAttention, dot_product_attention
+from tpu_dist.nn.core import Lambda, Module, Sequential, fanin_uniform
+from tpu_dist.nn.layers import (
+    AvgPool2D,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Dropout2D,
+    Embedding,
+    GlobalAvgPool,
+    LayerNorm,
+    MaxPool2D,
+    flatten,
+    gelu,
+    log_softmax,
+    relu,
+)
+from tpu_dist.nn.losses import accuracy, cross_entropy, nll_loss
+
+__all__ = [
+    "AvgPool2D",
+    "BatchNorm",
+    "Conv2D",
+    "Dense",
+    "Dropout",
+    "Dropout2D",
+    "Embedding",
+    "GlobalAvgPool",
+    "Lambda",
+    "LayerNorm",
+    "MaxPool2D",
+    "Module",
+    "MultiHeadAttention",
+    "Sequential",
+    "accuracy",
+    "cross_entropy",
+    "dot_product_attention",
+    "fanin_uniform",
+    "flatten",
+    "gelu",
+    "log_softmax",
+    "nll_loss",
+    "relu",
+]

@@ -1,0 +1,67 @@
+"""Attention — functional core + module wrapper.
+
+Not in the reference (its model is a 2-conv MNIST net, train_dist.py:53-71;
+SURVEY.md §2d records sequence models as absent), but first-class here: the
+ViT-Tiny extended config (BASELINE.json config 5) and the long-context
+sequence-parallel path (`tpu_dist.parallel.ring_attention`) both build on
+this exact function, so the single-device and ring-sharded paths are
+numerically comparable by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.nn.core import Module
+from tpu_dist.nn.layers import Dense
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Softmax attention. Shapes: (..., heads, seq, head_dim)."""
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("...hqd,...hkd->...hqk", q * scale, k)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("...hqk,...hkd->...hqd", weights, v)
+
+
+class MultiHeadAttention(Module):
+    """Standard MHA block over (batch, seq, dim) inputs."""
+
+    def __init__(self, dim: int, heads: int, *, causal: bool = False):
+        if dim % heads:
+            raise ValueError(f"dim {dim} not divisible by heads {heads}")
+        self.dim = dim
+        self.heads = heads
+        self.head_dim = dim // heads
+        self.causal = causal
+        self._qkv = Dense(3 * dim)
+        self._out = Dense(dim)
+
+    def init(self, key, input_shape):
+        k1, k2 = jax.random.split(key)
+        pq, _ = self._qkv.init(k1, input_shape)
+        po, _ = self._out.init(k2, input_shape[:-1] + (self.dim,))
+        return {"qkv": pq, "out": po}, {}
+
+    def apply(self, params, state, x, *, train=False, key=None):
+        b, s, _ = x.shape
+        qkv, _ = self._qkv.apply(params["qkv"], {}, x)
+        qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
+        q, k, v = (
+            jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)
+        )  # (b, h, s, hd)
+        o = dot_product_attention(q, k, v, causal=self.causal)
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
+        y, _ = self._out.apply(params["out"], {}, o)
+        return y, state

@@ -1,0 +1,113 @@
+"""Minimal functional module system.
+
+The reference leans on ``torch.nn`` (external) for its model layer
+(SURVEY.md §1 L3, train_dist.py:53-71).  Our equivalent is deliberately
+tiny and pure-functional — parameters and mutable statistics are explicit
+pytrees, so every model is directly jit/shard_map/grad-compatible and
+replication across a mesh is just an `out_sharding`:
+
+- ``Module.init(key, input_shape) -> (params, state)`` — shape-inferred
+  analytically from the per-example input shape (no batch dim).
+- ``Module.apply(params, state, x, *, train, key) -> (y, new_state)`` —
+  ``x`` is batched; ``state`` carries e.g. batch-norm running statistics
+  (returned unchanged by stateless layers).
+
+Default initializers mirror torch's ``kaiming_uniform(a=sqrt(5))`` /
+fan-in-uniform scheme so that the MNIST ConvNet here trains with the same
+dynamics as the reference's ``Net`` under identical hyperparameters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+State = Any
+Shape = tuple[int, ...]
+
+
+class Module:
+    """Base class: stateless unless a subclass overrides."""
+
+    def init(self, key: jax.Array, input_shape: Shape) -> tuple[Params, State]:
+        del key, input_shape
+        return {}, {}
+
+    def out_shape(self, input_shape: Shape) -> Shape:
+        return input_shape
+
+    def apply(
+        self,
+        params: Params,
+        state: State,
+        x: jax.Array,
+        *,
+        train: bool = False,
+        key: jax.Array | None = None,
+    ) -> tuple[jax.Array, State]:
+        raise NotImplementedError
+
+    def __call__(self, params, state, x, *, train=False, key=None):
+        return self.apply(params, state, x, train=train, key=key)
+
+
+def fanin_uniform(key, shape, fan_in, dtype=jnp.float32):
+    """torch's default weight/bias init: U(-1/sqrt(fan_in), 1/sqrt(fan_in))
+    (equivalent to kaiming_uniform with a=sqrt(5) for weights)."""
+    bound = 1.0 / jnp.sqrt(jnp.maximum(fan_in, 1.0))
+    return jax.random.uniform(key, shape, dtype, -1.0, 1.0) * bound
+
+
+class Sequential(Module):
+    """Composition with state threading and per-layer rng splitting."""
+
+    def __init__(self, layers: Sequence[Module]):
+        self.layers = list(layers)
+
+    def init(self, key, input_shape):
+        params, state = [], []
+        shape = input_shape
+        keys = jax.random.split(key, max(len(self.layers), 1))
+        for k, layer in zip(keys, self.layers):
+            p, s = layer.init(k, shape)
+            shape = layer.out_shape(shape)
+            params.append(p)
+            state.append(s)
+        return tuple(params), tuple(state)
+
+    def out_shape(self, input_shape):
+        shape = input_shape
+        for layer in self.layers:
+            shape = layer.out_shape(shape)
+        return shape
+
+    def apply(self, params, state, x, *, train=False, key=None):
+        keys = (
+            jax.random.split(key, max(len(self.layers), 1))
+            if key is not None
+            else [None] * len(self.layers)
+        )
+        new_state = []
+        for layer, p, s, k in zip(self.layers, params, state, keys):
+            x, s2 = layer.apply(p, s, x, train=train, key=k)
+            new_state.append(s2)
+        return x, tuple(new_state)
+
+
+class Lambda(Module):
+    """Stateless elementwise/structural op (relu, flatten, ...)."""
+
+    def __init__(self, fn: Callable[[jax.Array], jax.Array], shape_fn=None):
+        self.fn = fn
+        self.shape_fn = shape_fn
+
+    def out_shape(self, input_shape):
+        if self.shape_fn is not None:
+            return self.shape_fn(input_shape)
+        return input_shape
+
+    def apply(self, params, state, x, *, train=False, key=None):
+        return self.fn(x), state

@@ -1,0 +1,23 @@
+"""Loss functions — ``F.nll_loss`` analog (train_dist.py:120) and friends."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def nll_loss(log_probs: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean negative log likelihood over the batch, given log-probabilities
+    (the reference pairs ``log_softmax`` output with ``F.nll_loss``,
+    train_dist.py:71,120)."""
+    picked = jnp.take_along_axis(log_probs, targets[:, None], axis=-1)[:, 0]
+    return -picked.mean()
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Softmax cross-entropy from raw logits (ResNet/ViT heads)."""
+    return nll_loss(jax.nn.log_softmax(logits, axis=-1), targets)
+
+
+def accuracy(scores: jax.Array, targets: jax.Array) -> jax.Array:
+    return (scores.argmax(-1) == targets).mean()

@@ -1,0 +1,1 @@
+"""`tpu_dist.ops` — see package modules."""

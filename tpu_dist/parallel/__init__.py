@@ -1,0 +1,34 @@
+"""`tpu_dist.parallel` — parallelism strategies (SURVEY.md §2d).
+
+Data parallelism (the reference's centerpiece), the ppermute ring
+collectives (its hand-rolled allreduce, done right), and the sequence-
+parallel ring-attention extension built on the same ring substrate.
+"""
+
+from tpu_dist.parallel.data_parallel import (
+    DATA_AXIS,
+    average_gradients,
+    make_train_step,
+    replicate,
+    shard_batch,
+)
+from tpu_dist.parallel.ring_attention import ring_attention
+from tpu_dist.parallel.ring import (
+    ring_all_gather,
+    ring_all_reduce,
+    ring_all_reduce_chunked,
+    ring_reduce_scatter,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "average_gradients",
+    "make_train_step",
+    "replicate",
+    "ring_all_gather",
+    "ring_all_reduce",
+    "ring_all_reduce_chunked",
+    "ring_attention",
+    "ring_reduce_scatter",
+    "shard_batch",
+]

@@ -1,0 +1,116 @@
+"""Data parallelism — the reference's centerpiece, compiled the TPU way.
+
+The reference implements DistributedDataParallel by hand
+(tuto.md:204-321): replicate the model, shard the data, and after every
+backward pass call ``all_reduce`` *per parameter* then divide by world size
+(``average_gradients``, train_dist.py:94-100).  That per-tensor blocking
+loop is the didactic gap the tutorial itself points out vs real DDP
+(tuto.md:319-320: no bucketing, no compute/comm overlap).
+
+Under XLA the whole train step — forward, backward, gradient averaging,
+optimizer update — is one compiled SPMD program, so the collective is
+fused, bucketed, and overlapped with the backward pass by the compiler.
+Two styles are provided:
+
+- `average_gradients(grads, axis_name)`: the explicit `pmean` over the
+  gradient pytree — the literal ``average_gradients`` analog, used inside
+  a ``shard_map``'d step.
+- `make_train_step(...)`: builds the full jitted step over a mesh: batch
+  sharded on the ``data`` axis, params/opt-state replicated, gradients
+  averaged, update applied — the whole of train_dist.py:115-124 as one
+  XLA program per step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def average_gradients(grads: Any, axis_name: str = DATA_AXIS) -> Any:
+    """``average_gradients(model)`` (train_dist.py:94-100) over a pytree:
+    sum across data-parallel ranks, divide by world size — i.e. ``pmean``.
+    One fused collective over the whole tree instead of one blocking
+    all_reduce per parameter (and without the reference's type-guard bug,
+    SURVEY.md §2c.2)."""
+    return lax.pmean(grads, axis_name)
+
+
+def make_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Build the compiled data-parallel train step.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch, key) -> (loss, aux)`` computed on
+        the *local* shard of the batch.  ``aux`` is any pytree (e.g. new
+        model state, metrics).
+      optimizer: a `tpu_dist.train.optim.Optimizer` (init/update pair).
+      mesh: mesh whose ``axis_name`` axis shards the batch.
+      donate: donate params/opt-state buffers (in-place update on device).
+
+    Returns ``step(params, opt_state, batch, key) -> (params, opt_state,
+    loss, aux)`` where ``batch`` arrays are sharded on their leading axis
+    over ``axis_name`` and everything else is replicated.  The gradient
+    ``pmean`` — the whole of ``average_gradients`` — is inside the compiled
+    program, so XLA overlaps it with the backward pass (the fused design
+    required for the 8-chip scaling target, SURVEY.md §7 hard part (e)).
+    """
+    repl = NamedSharding(mesh, P())
+    sharded = NamedSharding(mesh, P(axis_name))
+
+    def spmd_step(params, opt_state, batch, key):
+        # Per-rank rng: fold in the data-parallel rank so e.g. dropout
+        # masks differ across shards (each rank sees different samples).
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, key
+        )
+        grads = average_gradients(grads, axis_name)
+        loss = lax.pmean(loss, axis_name)
+        # aux is computed per-shard; averaging floating leaves makes the
+        # returned value well-defined globally (metrics become means,
+        # batch-norm statistics become cross-replica means — SyncBN-style).
+        # Non-float leaves (counters, ints) must be rank-invariant.
+        aux = jax.tree.map(
+            lambda a: lax.pmean(a, axis_name)
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+            else a,
+            aux,
+        )
+        params, opt_state = optimizer.update(params, grads, opt_state)
+        return params, opt_state, loss, aux
+
+    mapped = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P()),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+def shard_batch(batch: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
+    """Place a host batch on the mesh, sharded over its leading axis —
+    the device-side analog of handing each process its partition."""
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Replicate a pytree (params/opt state) across the mesh — the model
+    replication half of data parallelism (tuto.md:216)."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

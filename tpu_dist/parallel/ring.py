@@ -1,0 +1,134 @@
+"""Ring collectives over ``lax.ppermute`` — the hand-rolled allreduce,
+rebuilt correctly.
+
+The reference hand-rolls a DeepSpeech-style ring allreduce over p2p
+(allreduce.py:8-34, prose tuto.md:322-354) — but the shipped code is buggy
+(zeros circulate; it accumulates the function *arguments* instead of the
+received buffers — SURVEY.md §2c.1) and both drivers fall back to the
+built-in collective (allreduce.py:44-45).  Here we implement the *intended*
+algorithm natively:
+
+- `ring_all_reduce`: the naive ring — ``n-1`` steps, each rank forwards the
+  buffer it received last step to ``right = (rank+1) % n`` and accumulates
+  (the double-buffer alternation of allreduce.py:22-32 becomes a
+  ``lax.scan`` carry; isend/wait overlap becomes XLA async dispatch of the
+  CollectivePermute).
+- `ring_reduce_scatter` + `ring_all_gather` and the bandwidth-optimal
+  chunked `ring_all_reduce_chunked` — the "reduce-scatter followed by
+  all-gather" exercise the tutorial leaves to the reader (tuto.md:354).
+  Each rank moves ``2·(n-1)/n`` of the payload instead of ``n-1`` copies.
+
+All are cross-checked against ``lax.psum`` in tests (the north-star parity
+requirement, BASELINE.md) and must match within fp tolerance.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.comm.collectives import ring_perm as _ring_perm
+from tpu_dist.comm.mesh import DEFAULT_AXIS
+
+
+def ring_all_reduce(x: jax.Array, axis_name: str = DEFAULT_AXIS) -> jax.Array:
+    """Naive ring allreduce: ``n-1`` full-tensor hops.
+
+    Step i: forward the buffer received at step i-1 (initially the local
+    tensor) to the right neighbor; accumulate what arrives from the left.
+    After ``n-1`` steps every rank has summed every contribution exactly
+    once.  This is the algorithm allreduce.py:8-34 *intends* (SURVEY.md
+    §2c.1 documents the reference's bug).
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    perm = _ring_perm(n)
+
+    def step(carry, _):
+        acc, buf = carry
+        buf = lax.ppermute(buf, axis_name, perm)
+        return (acc + buf, buf), None
+
+    (acc, _), _ = lax.scan(step, (x, x), None, length=n - 1)
+    return acc
+
+
+def _pad_to_multiple(flat: jax.Array, n: int) -> jax.Array:
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
+def ring_reduce_scatter(x: jax.Array, axis_name: str = DEFAULT_AXIS) -> jax.Array:
+    """Ring reduce-scatter: after ``n-1`` chunk hops, rank r holds the fully
+    reduced chunk ``(r+1) % n`` of the flattened (zero-padded) input.
+
+    Returns the owned chunk, shape ``(ceil(size/n),)``.  Chunk ownership is
+    the standard ring schedule: at step t, rank r sends chunk ``(r-t) % n``
+    and reduces into chunk ``(r-t-1) % n``.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    chunks = _pad_to_multiple(x.reshape(-1), n).reshape(n, -1)
+    if n == 1:
+        return chunks[0]
+    perm = _ring_perm(n)
+
+    def step(chunks, t):
+        send_idx = (r - t) % n
+        recv_idx = (r - t - 1) % n
+        buf = lax.dynamic_index_in_dim(chunks, send_idx, 0, keepdims=False)
+        buf = lax.ppermute(buf, axis_name, perm)
+        updated = lax.dynamic_index_in_dim(chunks, recv_idx, 0, keepdims=False) + buf
+        return lax.dynamic_update_index_in_dim(chunks, updated, recv_idx, 0), None
+
+    chunks, _ = lax.scan(step, chunks, jnp.arange(n - 1))
+    return lax.dynamic_index_in_dim(chunks, (r + 1) % n, 0, keepdims=False)
+
+
+def ring_all_gather(
+    chunk: jax.Array,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    owner_offset: int = 0,
+) -> jax.Array:
+    """Ring all-gather: rank r starts owning chunk ``(r + owner_offset) % n``;
+    after ``n-1`` hops every rank holds all chunks, ordered by owner index.
+
+    Returns shape ``(n,) + chunk.shape``.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    out = jnp.zeros((n,) + chunk.shape, chunk.dtype)
+    out = lax.dynamic_update_index_in_dim(out, chunk, (r + owner_offset) % n, 0)
+    if n == 1:
+        return out
+    perm = _ring_perm(n)
+
+    def step(carry, t):
+        out, buf = carry
+        buf = lax.ppermute(buf, axis_name, perm)
+        # arrived from rank r-1-t, who owns chunk (r-1-t+owner_offset) % n
+        idx = (r - 1 - t + owner_offset) % n
+        out = lax.dynamic_update_index_in_dim(out, buf, idx, 0)
+        return (out, buf), None
+
+    (out, _), _ = lax.scan(step, (out, chunk), jnp.arange(n - 1))
+    return out
+
+
+def ring_all_reduce_chunked(
+    x: jax.Array, axis_name: str = DEFAULT_AXIS
+) -> jax.Array:
+    """Bandwidth-optimal ring allreduce = reduce-scatter + all-gather
+    (the tuto.md:354 exercise).  ``2·(n-1)`` hops of ``size/n`` each."""
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    own = ring_reduce_scatter(x, axis_name)  # rank r owns chunk (r+1) % n
+    gathered = ring_all_gather(own, axis_name, owner_offset=1)
+    flat = gathered.reshape(-1)[: x.size]
+    return flat.reshape(x.shape)

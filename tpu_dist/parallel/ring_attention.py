@@ -1,0 +1,108 @@
+"""Ring attention — sequence/context parallelism over the ppermute ring.
+
+The reference has no sequence models (SURVEY.md §2d records SP/CP as
+absent; its only ring is the ring *allreduce*, allreduce.py:18-32), but the
+communication topology is identical: blocks circulate around the same
+neighbor ring the hand-rolled allreduce uses.  This module makes
+long-context a first-class capability: sequences sharded over a mesh axis,
+K/V blocks rotated via ``lax.ppermute``, attention accumulated blockwise
+with a numerically-stable streaming softmax (the log-sum-exp running
+rescale of Flash/Ring attention), so no device ever materializes the full
+(seq × seq) score matrix or the full K/V.
+
+Communication per step rides ICI exactly like `ring_all_reduce`; compute
+(the two einsums) stays on the MXU, and XLA overlaps the next block's
+CollectivePermute with the current block's matmuls inside the scanned body.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.comm.collectives import ring_perm
+
+NEG_INF = -1e30
+
+
+def _block_update(m, l, acc, logits, v_blk, mask):
+    """One streaming-softmax accumulation step.
+
+    m: (..., sq) running row max;  l: (..., sq) running denominator;
+    acc: (..., sq, d) running numerator; logits: (..., sq, sk);
+    mask: broadcastable to logits (True = attend).
+    """
+    logits = jnp.where(mask, logits, NEG_INF)
+    m_new = jnp.maximum(m, logits.max(-1))
+    # Rescale previous accumulation; exp of fully-masked entries is zeroed
+    # by re-masking (NEG_INF is finite, so no NaNs from inf - inf).
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(logits - m_new[..., None])
+    p = jnp.where(mask, p, 0.0)
+    l_new = l * correction + p.sum(-1)
+    acc_new = acc * correction[..., None] + jnp.einsum("...qk,...kd->...qd", p, v_blk)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Blockwise ring attention over sequence shards.
+
+    Args:
+      q, k, v: local shards of shape ``(..., s_local, d)`` (e.g.
+        ``(batch, heads, s_local, d)``), with the sequence axis sharded
+        over mesh axis ``axis_name``; global sequence order is rank-major.
+      causal: apply a causal mask over *global* positions.
+
+    Returns the local output shard ``(..., s_local, d)`` in the input
+    dtype.  Numerically matches `tpu_dist.nn.dot_product_attention` on the
+    gathered sequence (tests assert this on the simulated mesh).
+    Accumulators (running max / denominator / numerator) are kept in
+    float32 regardless of input dtype — with bf16 inputs on long
+    sequences, accumulating thousands of exp terms in an 8-bit mantissa
+    would destroy the streaming softmax (standard flash/ring practice).
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    s_local = q.shape[-2]
+    d = q.shape[-1]
+    scale = d**-0.5
+    qs = (q * scale).astype(q.dtype)
+
+    perm = ring_perm(n)
+    lead = q.shape[:-2]
+    m0 = jnp.full(lead + (s_local,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros(lead + (s_local,), jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+
+    local_pos = jnp.arange(s_local)
+
+    def step(carry, t):
+        m, l, acc, k_blk, v_blk = carry
+        # K/V blocks travel rank -> rank+1, so at step t we hold the block
+        # that originated at rank (r - t) mod n.
+        kv_rank = (r - t) % n
+        # MXU matmul in input precision; softmax bookkeeping in f32.
+        logits = jnp.einsum(
+            "...qd,...kd->...qk", qs, k_blk, preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = r * s_local + local_pos  # global query positions
+            k_pos = kv_rank * s_local + local_pos
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((s_local, s_local), bool)
+        m, l, acc = _block_update(m, l, acc, logits, v_blk, mask)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, acc, k_blk, v_blk), None
+
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    return (acc / l[..., None]).astype(q.dtype)

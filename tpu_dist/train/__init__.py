@@ -1,0 +1,5 @@
+"""`tpu_dist.train` — optimizers, training loop, checkpointing, metrics."""
+
+from tpu_dist.train.optim import Optimizer, adamw, sgd
+
+__all__ = ["Optimizer", "adamw", "sgd"]

@@ -1,0 +1,17 @@
+"""`tpu_dist.utils` — pytree and misc helpers."""
+
+from tpu_dist.utils.tree import (
+    global_norm,
+    tree_allclose,
+    tree_bytes,
+    tree_cast,
+    tree_size,
+)
+
+__all__ = [
+    "global_norm",
+    "tree_allclose",
+    "tree_bytes",
+    "tree_cast",
+    "tree_size",
+]
