@@ -1,0 +1,30 @@
+# Run targets per demo (the reference Makefile's `make ptp` pattern,
+# Makefile:8-9) + test/bench entries.
+
+PY ?= python
+WORLD ?= 8
+PLATFORM ?= cpu
+DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
+
+.PHONY: test ptp gather allreduce train bench runtime
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+ptp:
+	cd demos && $(PY) ptp.py --world 2 --platform $(PLATFORM)
+
+gather:
+	cd demos && $(PY) gather.py $(DEMOFLAGS)
+
+allreduce:
+	cd demos && $(PY) allreduce.py --world 4 --platform $(PLATFORM) --bench 10
+
+train:
+	cd demos && $(PY) train_dist.py $(DEMOFLAGS) --epochs 3 --samples 8192
+
+bench:
+	$(PY) bench.py
+
+runtime:
+	$(MAKE) -C tpu_dist/runtime

@@ -1,0 +1,140 @@
+"""Headline benchmark: MNIST data-parallel train-step throughput per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": R}
+
+The workload is the reference's north-star config (BASELINE.json config 3 /
+train_dist.py): the LeNet-style ConvNet, global batch 128, SGD(0.01, 0.5),
+full fused train step (forward + NLL + backward + gradient allreduce +
+update).  ``vs_baseline`` compares against the reference implementation's
+stack measured in-container: the same model/step in torch (CPU — the
+reference's Gloo-on-CPU dev path, train_dist.py:130), since the reference
+publishes no numbers (BASELINE.md).
+
+All progress chatter goes to stderr; stdout carries exactly the one JSON
+line the driver records.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+BATCH = 128
+TIMED_STEPS = 60
+WARMUP = 5
+
+
+def bench_tpu_dist() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import comm, data, models, parallel, train
+
+    devs = jax.devices()
+    log(f"devices: {devs}")
+    mesh = comm.make_mesh(1, ("data",), mesh_devices=devs[:1])
+
+    model = models.mnist_net()
+    cfg = train.TrainConfig()
+    trainer = train.Trainer(model, models.IN_SHAPE, mesh, cfg)
+
+    ds = data.load_mnist("train", synthetic_size=BATCH * 4)
+    x = np.stack([ds[i][0] for i in range(BATCH)])
+    y = np.asarray([ds[i][1] for i in range(BATCH)], np.int32)
+    batch = parallel.shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+
+    import jax.random as jrandom
+
+    key = jrandom.key(0)
+    p, ms, os_ = trainer.params, trainer.model_state, trainer.opt_state
+    for i in range(WARMUP):
+        p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
+    jax.block_until_ready(loss)
+    log(f"warmup done, loss={float(loss):.4f}")
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_STEPS):
+        p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    sps = TIMED_STEPS * BATCH / dt
+    log(f"tpu_dist: {TIMED_STEPS} steps in {dt:.3f}s -> {sps:,.0f} samples/s/chip")
+    return sps
+
+
+def bench_torch_reference() -> float:
+    """The reference stack's throughput on the same workload (torch CPU —
+    its dev backend).  Architecture re-stated per train_dist.py:53-71."""
+    import torch
+    import torch.nn as tnn
+    import torch.nn.functional as F
+
+    torch.manual_seed(1234)
+    torch.set_num_threads(max(torch.get_num_threads(), 4))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = tnn.Conv2d(1, 10, 5)
+            self.c2 = tnn.Conv2d(10, 20, 5)
+            self.drop2d = tnn.Dropout2d()
+            self.f1 = tnn.Linear(320, 50)
+            self.f2 = tnn.Linear(50, 10)
+
+        def forward(self, x):
+            x = F.relu(F.max_pool2d(self.c1(x), 2))
+            x = F.relu(F.max_pool2d(self.drop2d(self.c2(x)), 2))
+            x = x.flatten(1)
+            x = F.dropout(F.relu(self.f1(x)), training=self.training)
+            return F.log_softmax(self.f2(x), dim=1)
+
+    net = Net()
+    opt = torch.optim.SGD(net.parameters(), lr=0.01, momentum=0.5)
+    x = torch.randn(BATCH, 1, 28, 28)
+    y = torch.randint(0, 10, (BATCH,))
+
+    def step():
+        opt.zero_grad()
+        loss = F.nll_loss(net(x), y)
+        loss.backward()
+        opt.step()
+
+    for _ in range(3):
+        step()
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        step()
+    dt = time.perf_counter() - t0
+    sps = n * BATCH / dt
+    log(f"torch-cpu reference: {n} steps in {dt:.3f}s -> {sps:,.0f} samples/s")
+    return sps
+
+
+def main():
+    value = bench_tpu_dist()
+    try:
+        baseline = bench_torch_reference()
+    except Exception as e:  # torch missing/broken should not kill the bench
+        log(f"torch baseline failed: {e}")
+        baseline = None
+    result = {
+        "metric": "mnist_dp_train_samples_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(value / baseline, 2) if baseline else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

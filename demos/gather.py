@@ -1,0 +1,35 @@
+"""Gather demo — behavior parity with the reference's (misnamed) ptp.py.
+
+Each rank contributes ``ones(1)``; the root gathers the stack and prints
+the sum, which must equal the world size (ptp.py:21-28 known answer).
+TPU collectives are symmetric, so "root" is a post-hoc slice of an
+all-gather (SURVEY.md §2a 'Gather demo').
+"""
+
+import jax.numpy as jnp
+
+from _common import parse_args
+
+
+def run():
+    from tpu_dist import comm
+
+    gathered = comm.gather(jnp.ones(1), dst=0)
+    return gathered.sum()
+
+
+def main():
+    args = parse_args(default_world=2)
+    from tpu_dist import comm
+
+    out = comm.spmd(run, world=args.world, platform=args.platform)
+    world = out.shape[0]
+    for r in range(world):
+        print(
+            f"Rank {r} sum after gather: {float(out[r]):.1f} "
+            f"(expect {world if r == 0 else 0}.0 — root holds the stack)"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Point-to-point demo: blocking-semantics send/recv ping-pong.
+
+The real p2p demo the reference documents in prose but never ships
+(tuto.md:79-121; its ``ptp.py`` actually demos gather — SURVEY.md §2c.4).
+Rank 0 increments and sends; rank 1 receives — both ranks end with 1.0
+(the tuto.md:91-95 known answer), then the ball bounces back.
+
+In compiled SPMD the "both processes stop until the communication is
+completed" semantics (tuto.md:97) hold by construction: the
+CollectivePermute is a lockstep program point.  The isend/irecv
+"immediate" variant maps to XLA async dispatch — the compiler overlaps the
+transfer with unrelated compute, and data-flow ordering plays the role of
+``req.wait()`` (you cannot read the result before it exists — the
+tuto.md:114-120 race is unrepresentable).
+"""
+
+import jax.numpy as jnp
+
+from _common import parse_args
+
+
+def run():
+    """Rank-style demo body (the reference's ``run(rank, size)`` shape)."""
+    from tpu_dist import comm
+
+    rank = comm.rank()
+    t = jnp.zeros(1)
+    # rank 0: t += 1; send to rank 1 (both end with 1.0)
+    t = comm.send(jnp.where(rank == 0, t + 1, t), dst=1, src=0)
+    ping = t
+    # pong: rank 1 increments and returns it
+    t = comm.send(jnp.where(rank == 1, t + 1, t), dst=0, src=1)
+    return ping, t
+
+
+def main():
+    args = parse_args(default_world=2)
+    from tpu_dist import comm
+
+    ping, pong = comm.spmd(run, world=args.world, platform=args.platform)
+    for r in range(ping.shape[0]):
+        print(f"Rank {r} has data {float(ping[r][0]):.1f} after ping "
+              f"(expect 1.0), {float(pong[r][0]):.1f} after pong (expect 2.0)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Distributed synchronous SGD on MNIST — the main-event demo.
+
+Behavioral parity with train_dist.py:103-127: seed 1234, deterministic
+equal-shard partition of MNIST, global batch 128 (``128 // world`` per
+rank), the reference ConvNet, SGD(lr=0.01, momentum=0.5), 10 epochs,
+per-epoch mean loss printed.  The per-batch body — forward, NLL loss,
+backward, gradient averaging (the whole of ``average_gradients``,
+train_dist.py:94-100), SGD update — is ONE compiled SPMD program over the
+mesh; XLA overlaps the gradient all-reduce with the backward pass instead
+of issuing one blocking collective per parameter (tuto.md:319-320's noted
+didactic gap, closed).
+
+Uses real MNIST IDX files when present (``$TPU_DIST_DATA_DIR``), otherwise
+the deterministic synthetic stand-in (zero-egress container) — see
+`tpu_dist.data.mnist`.
+"""
+
+from _common import parse_args
+
+
+def main():
+    args = parse_args(
+        default_world=None,
+        epochs=(int, 10, "training epochs (reference: 10)"),
+        samples=(int, 0, "cap dataset size (0 = full 60k)"),
+    )
+    from tpu_dist import comm, data, models, train
+
+    world = args.world or len(comm.devices(args.platform))
+    mesh = comm.make_mesh(world, ("data",), platform=args.platform)
+    ds = data.load_mnist("train", synthetic_size=args.samples or None)
+    kind = "synthetic" if ds.synthetic else "real"
+    print(f"MNIST ({kind}, {len(ds)} samples) on {world} ranks "
+          f"[{mesh.devices.flat[0].platform}]")
+
+    trainer = train.Trainer(
+        models.mnist_net(),
+        models.IN_SHAPE,
+        mesh,
+        train.TrainConfig(epochs=args.epochs),
+    )
+    trainer.fit(ds)
+    test = data.load_mnist("test", synthetic_size=min(10000, len(ds)) if ds.synthetic else None)
+    print(f"Test accuracy: {trainer.evaluate(test):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Data-layer tests: the determinism invariant is the core correctness
+property (SURVEY.md §2c.6 — same seed ⇒ same shuffle on every rank ⇒
+disjoint shards with zero communication)."""
+
+import numpy as np
+import pytest
+
+from tpu_dist import data
+
+
+class FakeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((2,), float(i), np.float32), i % 10)
+
+
+class TestPartitioner:
+    def test_default_fractions(self):
+        p = data.DataPartitioner(FakeDataset(100))
+        assert [len(p.use(i)) for i in range(3)] == [70, 20, 10]
+
+    def test_same_seed_same_split_across_instances(self):
+        a = data.DataPartitioner(FakeDataset(1000), data.equal_shards(4))
+        b = data.DataPartitioner(FakeDataset(1000), data.equal_shards(4))
+        for i in range(4):
+            assert a.partitions[i] == b.partitions[i]
+
+    def test_shards_disjoint_and_cover(self):
+        p = data.DataPartitioner(FakeDataset(1000), data.equal_shards(4))
+        all_idx = sorted(sum((p.partitions[i] for i in range(4)), []))
+        assert all_idx == list(range(1000))
+
+    def test_different_seed_different_split(self):
+        a = data.DataPartitioner(FakeDataset(1000), seed=1234)
+        b = data.DataPartitioner(FakeDataset(1000), seed=4321)
+        assert a.partitions[0] != b.partitions[0]
+
+    def test_partition_view_indirection(self):
+        p = data.Partition(FakeDataset(10), [3, 7])
+        assert len(p) == 2
+        assert p[0][1] == 3 and p[1][1] == 7
+
+
+class TestLoader:
+    def test_batch_shapes_and_drop_last(self):
+        ds = FakeDataset(103)
+        loader = data.Loader(data.Partition(ds, range(103)), 10)
+        batches = list(loader.epoch(0))
+        assert len(batches) == 10  # drop_last
+        assert batches[0][0].shape == (10, 2)
+
+    def test_epoch_shuffles_differ_but_are_reproducible(self):
+        ds = FakeDataset(64)
+        loader = data.Loader(data.Partition(ds, range(64)), 32, seed=7)
+        e0 = [b[1] for b in loader.epoch(0)]
+        e0b = [b[1] for b in loader.epoch(0)]
+        e1 = [b[1] for b in loader.epoch(1)]
+        np.testing.assert_array_equal(e0[0], e0b[0])
+        assert not np.array_equal(e0[0], e1[0])
+
+
+class TestDistributedLoader:
+    def test_global_batch_semantics(self):
+        # train_dist.py:85: constant global batch, 128 // world per rank.
+        ds = data.synthetic_mnist(512)
+        dl = data.DistributedLoader(ds, 8, 128)
+        assert dl.local_batch == 16
+        x, y = next(iter(dl.epoch(0)))
+        assert x.shape == (128, 28, 28, 1)
+        assert y.shape == (128,)
+
+    def test_rank_major_stacking_uses_disjoint_shards(self):
+        ds = FakeDataset(64)
+        dl = data.DistributedLoader(ds, 4, 16)
+        seen_per_rank = [set() for _ in range(4)]
+        for x, y in dl.epoch(0):
+            for r in range(4):
+                chunk = x[r * 4 : (r + 1) * 4, 0]
+                seen_per_rank[r].update(int(v) for v in chunk)
+        for a in range(4):
+            for b in range(a + 1, 4):
+                assert not (seen_per_rank[a] & seen_per_rank[b])
+
+    def test_indivisible_batch_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            data.DistributedLoader(FakeDataset(64), 3, 128)
+
+
+class TestMnist:
+    def test_synthetic_deterministic(self):
+        a = data.synthetic_mnist(100)
+        b = data.synthetic_mnist(100)
+        np.testing.assert_array_equal(a.images, b.images)
+        np.testing.assert_array_equal(a.labels, b.labels)
+
+    def test_train_test_share_templates_but_differ(self):
+        tr = data.synthetic_mnist(100, seed=0)
+        te = data.synthetic_mnist(100, seed=1)
+        assert not np.array_equal(tr.images[:10], te.images[:10])
+
+    def test_normalization(self):
+        ds = data.synthetic_mnist(100)
+        # normalized with MNIST constants: raw 0 maps to -mean/std
+        lo = (0.0 - data.mnist.MEAN) / data.mnist.STD
+        hi = (1.0 - data.mnist.MEAN) / data.mnist.STD
+        assert ds.images.min() >= lo - 1e-5
+        assert ds.images.max() <= hi + 1e-5
+
+    def test_idx_roundtrip(self, tmp_path):
+        """Write a tiny IDX pair and parse it back."""
+        import struct
+
+        imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+        labels = np.array([3, 7], np.uint8)
+        ip = tmp_path / "train-images-idx3-ubyte"
+        lp = tmp_path / "train-labels-idx1-ubyte"
+        ip.write_bytes(struct.pack(">IIII", 2051, 2, 28, 28) + imgs.tobytes())
+        lp.write_bytes(struct.pack(">II", 2049, 2) + labels.tobytes())
+        got_i = data.load_idx_images(ip)
+        got_l = data.load_idx_labels(lp)
+        np.testing.assert_array_equal(got_i[..., 0], imgs)
+        np.testing.assert_array_equal(got_l, [3, 7])

@@ -1,0 +1,102 @@
+"""Model zoo tests: shapes, parameter counts, state threading, and a
+train-ability smoke for each family."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist import models, nn
+from tpu_dist.utils import tree_size
+
+
+class TestMnistNet:
+    def test_forward_shape_and_logprobs(self):
+        net = models.mnist_net()
+        params, state = net.init(jax.random.key(0), models.IN_SHAPE)
+        y, _ = net.apply(params, state, jnp.ones((4,) + models.IN_SHAPE))
+        assert y.shape == (4, 10)
+        np.testing.assert_allclose(np.exp(np.asarray(y)).sum(-1), 1.0, rtol=1e-5)
+
+    def test_param_count_matches_reference_arch(self):
+        # conv1: 5*5*1*10+10; conv2: 5*5*10*20+20; fc1: 320*50+50; fc2: 50*10+10
+        expect = (250 + 10) + (5000 + 20) + (16000 + 50) + (500 + 10)
+        net = models.mnist_net()
+        params, _ = net.init(jax.random.key(0), models.IN_SHAPE)
+        assert tree_size(params) == expect
+
+    def test_flatten_is_320(self):
+        net = models.mnist_net()
+        # shape after the conv/pool stack must be 320 (train_dist.py:67)
+        shape = models.IN_SHAPE
+        for layer in net.layers[:8]:
+            shape = layer.out_shape(shape)
+        assert shape == (320,)
+
+
+class TestResNet18:
+    def test_forward_and_state(self):
+        net = models.resnet18(num_classes=10)
+        params, state = net.init(jax.random.key(0), (32, 32, 3))
+        x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+        y, new_state = net.apply(params, state, x, train=True)
+        assert y.shape == (2, 10)
+        # ~11.2M params for CIFAR ResNet-18
+        n = tree_size(params)
+        assert 10_500_000 < n < 11_500_000, n
+        # batch-norm state must move in train mode
+        before = jax.tree.leaves(state)
+        after = jax.tree.leaves(new_state)
+        assert any(
+            not np.allclose(np.asarray(a), np.asarray(b))
+            for a, b in zip(before, after)
+        )
+
+    def test_eval_mode_deterministic(self):
+        net = models.resnet18(num_classes=10)
+        params, state = net.init(jax.random.key(0), (32, 32, 3))
+        x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+        y1, s1 = net.apply(params, state, x, train=False)
+        y2, s2 = net.apply(params, state, x, train=False)
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+        for a, b in zip(jax.tree.leaves(s1), jax.tree.leaves(s2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestViT:
+    def test_tiny_shapes_and_size(self):
+        net = models.vit_tiny(image_size=32, patch=8, num_classes=10)
+        params, state = net.init(jax.random.key(0), (32, 32, 3))
+        x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+        y, _ = net.apply(params, state, x)
+        assert y.shape == (2, 10)
+
+    def test_vit_tiny_imagenet_param_count(self):
+        net = models.vit_tiny()
+        params, _ = net.init(jax.random.key(0), (224, 224, 3))
+        n = tree_size(params)
+        # ViT-Ti/16: ~5.7M params
+        assert 5_000_000 < n < 6_500_000, n
+
+    def test_indivisible_patch_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            models.vit_tiny(image_size=30, patch=16)
+
+    def test_learns_tiny_task(self):
+        """A few SGD steps reduce loss on a 2-class toy problem."""
+        net = models.vit_tiny(image_size=8, patch=4, num_classes=2)
+        net.blocks = net.blocks[:2]  # shrink depth for speed
+        params, state = net.init(jax.random.key(0), (8, 8, 3))
+        x = jax.random.normal(jax.random.key(1), (16, 8, 8, 3))
+        y = (x.mean((1, 2, 3)) > 0).astype(jnp.int32)
+
+        def loss_fn(p):
+            logits, _ = net.apply(p, state, x)
+            return nn.cross_entropy(logits, y)
+
+        l0 = float(loss_fn(params))
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+        for _ in range(20):
+            l, g = grad_fn(params)
+            params = jax.tree.map(lambda p, g_: p - 0.05 * g_, params, g)
+        assert float(l) < l0

@@ -1,0 +1,68 @@
+"""Integration tests: the short-MNIST-run checks SURVEY.md §4 prescribes —
+loss decrease + determinism — plus checkpoint roundtrip."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dist import comm, data, models, train
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return comm.make_mesh(8, ("data",), platform="cpu")
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    return data.load_mnist("train", synthetic_size=2048)
+
+
+def _make_trainer(mesh, epochs=2, silent=True):
+    cfg = train.TrainConfig(
+        epochs=epochs, log=(lambda s: None) if silent else print
+    )
+    return train.Trainer(models.mnist_net(), models.IN_SHAPE, mesh, cfg)
+
+
+def test_loss_decreases(mesh, dataset):
+    t = _make_trainer(mesh, epochs=3)
+    hist = t.fit(dataset)
+    assert hist[-1].mean_loss < hist[0].mean_loss
+
+
+def test_training_is_deterministic(mesh, dataset):
+    a = _make_trainer(mesh, epochs=1).fit(dataset)
+    b = _make_trainer(mesh, epochs=1).fit(dataset)
+    assert a[0].mean_loss == pytest.approx(b[0].mean_loss, abs=0.0), (
+        "same seed must give bit-identical training (the reference's "
+        "cross-rank identity invariant, train_dist.py:105)"
+    )
+
+
+def test_evaluate_runs(mesh, dataset):
+    t = _make_trainer(mesh, epochs=1)
+    t.fit(dataset)
+    acc = t.evaluate(data.load_mnist("test", synthetic_size=1000))
+    assert 0.0 <= acc <= 1.0
+
+
+def test_checkpoint_roundtrip(tmp_path, mesh):
+    t = _make_trainer(mesh, epochs=1)
+    ckpt = tmp_path / "state.npz"
+    train.checkpoint.save(ckpt, {"params": t.params, "opt": t.opt_state}, step=5)
+    like = {"params": t.params, "opt": t.opt_state}
+    restored, step = train.checkpoint.restore(ckpt, like)
+    assert step == 5
+    for a, b in zip(
+        jax.tree.leaves(restored["params"]), jax.tree.leaves(t.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_structure_mismatch_raises(tmp_path, mesh):
+    t = _make_trainer(mesh, epochs=1)
+    ckpt = tmp_path / "state.npz"
+    train.checkpoint.save(ckpt, {"params": t.params}, step=1)
+    with pytest.raises(ValueError, match="structure mismatch"):
+        train.checkpoint.restore(ckpt, {"different": t.params})

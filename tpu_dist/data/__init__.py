@@ -1,1 +1,24 @@
-"""`tpu_dist.data` — see package modules."""
+"""`tpu_dist.data` — partitioning and loading (SURVEY.md §1 L4)."""
+
+from tpu_dist.data.loader import DistributedLoader, Loader
+from tpu_dist.data.mnist import (
+    Dataset,
+    load_idx_images,
+    load_idx_labels,
+    load_mnist,
+    synthetic_mnist,
+)
+from tpu_dist.data.partition import DataPartitioner, Partition, equal_shards
+
+__all__ = [
+    "DataPartitioner",
+    "Dataset",
+    "DistributedLoader",
+    "Loader",
+    "Partition",
+    "equal_shards",
+    "load_idx_images",
+    "load_idx_labels",
+    "load_mnist",
+    "synthetic_mnist",
+]

@@ -1,0 +1,129 @@
+"""Batch loading — the ``DataLoader(partition, bsz, shuffle=True)`` analog
+(train_dist.py:89-90) plus the mesh-aware distributed loader.
+
+XLA needs static shapes, so batches are fixed-size: with ``drop_last=True``
+(default) the trailing partial batch is dropped — one compiled program for
+every step.  Shuffling is seeded per epoch (reproducible, and identical
+across hosts given the same seed, preserving the reference's determinism
+invariant SURVEY.md §2c.6).
+
+`DistributedLoader` reproduces the reference's per-rank semantics on a
+single-controller mesh: rank r's batch comes from partition r
+(`DataPartitioner.use(r)`, each with its own per-epoch shuffle), and the
+per-rank batches are stacked rank-major so slicing the global batch over
+the ``data`` mesh axis hands each device exactly its partition's samples —
+the same samples the reference's per-process loaders would deliver.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from tpu_dist.data.mnist import Dataset
+from tpu_dist.data.partition import DataPartitioner, Partition, equal_shards
+
+
+class Loader:
+    """Single-shard loader: seeded per-epoch shuffle, fixed batch size."""
+
+    def __init__(
+        self,
+        partition,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        seed: int = 1234,
+    ):
+        self.partition = partition
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+
+    def __len__(self) -> int:
+        n = len(self.partition)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def epoch(self, epoch: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        n = len(self.partition)
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + epoch).permutation(n)
+        nb = len(self)
+        # Fast path: a Partition over an array-backed Dataset admits fancy
+        # indexing — one vectorized gather per batch instead of per-sample
+        # Python __getitem__ calls (this is the host-side hot input path).
+        part = self.partition
+        data = getattr(part, "data", None)
+        if (
+            hasattr(part, "indices")
+            and hasattr(data, "images")
+            and hasattr(data, "labels")
+        ):
+            global_idx = np.asarray(part.indices)[order]
+            for b in range(nb):
+                idx = global_idx[b * self.batch_size : (b + 1) * self.batch_size]
+                yield data.images[idx], data.labels[idx]
+            return
+        for b in range(nb):
+            idx = order[b * self.batch_size : (b + 1) * self.batch_size]
+            xs, ys = zip(*(part[int(i)] for i in idx))
+            yield np.stack(xs), np.asarray(ys)
+
+
+class DistributedLoader:
+    """Global-batch loader over a deterministic partition per rank.
+
+    Reproduces ``partition_dataset`` (train_dist.py:74-91): equal
+    fractional shards from a seed-1234 global shuffle, per-rank batch size
+    ``global_batch // world_size`` (constant global batch, train_dist.py:85),
+    per-epoch per-rank shuffles.  Yields ``(x, y)`` global batches stacked
+    rank-major, ready for `tpu_dist.parallel.shard_batch`.
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        world_size: int,
+        global_batch: int = 128,
+        *,
+        seed: int = 1234,
+        shuffle: bool = True,
+    ):
+        if global_batch % world_size:
+            raise ValueError(
+                f"global batch {global_batch} not divisible by world size "
+                f"{world_size}"
+            )
+        self.world_size = world_size
+        self.local_batch = global_batch // world_size
+        partitioner = DataPartitioner(dataset, equal_shards(world_size), seed=seed)
+        self.loaders = [
+            Loader(
+                partitioner.use(r),
+                self.local_batch,
+                shuffle=shuffle,
+                # Distinct stream per rank, like each process's own
+                # DataLoader shuffle.
+                seed=seed + 1000 * r,
+            )
+            for r in range(world_size)
+        ]
+
+    def __len__(self) -> int:
+        return min(len(l) for l in self.loaders)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return len(self)
+
+    def epoch(self, epoch: int) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        iters = [l.epoch(epoch) for l in self.loaders]
+        for _ in range(len(self)):
+            parts = [next(it) for it in iters]
+            x = np.concatenate([p[0] for p in parts])
+            y = np.concatenate([p[1] for p in parts])
+            yield x, y

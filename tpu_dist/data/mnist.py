@@ -1,0 +1,140 @@
+"""MNIST without torchvision.
+
+The reference downloads MNIST via ``datasets.MNIST`` and normalizes with
+(0.1307, 0.3081) (train_dist.py:76-83).  This container has zero egress and
+no torchvision, so we provide:
+
+1. an IDX-format parser (``load_idx_images``/``load_idx_labels``) that
+   reads standard ``train-images-idx3-ubyte`` files (optionally .gz) from
+   ``$TPU_DIST_DATA_DIR`` or common locations, and
+2. a deterministic synthetic fallback (``synthetic_mnist``): 10 fixed
+   seeded class templates + per-sample noise — learnable by the same
+   ConvNet, fully reproducible, clearly labeled as synthetic.
+
+Either path yields NHWC float32 images (28, 28, 1), normalized with the
+reference's constants, and int32 labels.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+MEAN, STD = 0.1307, 0.3081  # train_dist.py:81
+
+_SEARCH_DIRS = (
+    os.environ.get("TPU_DIST_DATA_DIR", ""),
+    "data/mnist",
+    "data",
+    os.path.expanduser("~/data/mnist"),
+    "/root/data/mnist",
+)
+
+
+@dataclass
+class Dataset:
+    """In-memory image-classification dataset (indexable like the torch
+    Dataset the reference's DataLoader wraps)."""
+
+    images: np.ndarray  # (n, 28, 28, 1) float32, normalized
+    labels: np.ndarray  # (n,) int32
+    synthetic: bool = False
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def __getitem__(self, i):
+        return self.images[i], self.labels[i]
+
+
+def _open_maybe_gz(path: Path):
+    if path.suffix == ".gz":
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def load_idx_images(path: Path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic}")
+        buf = f.read(n * rows * cols)
+    return np.frombuffer(buf, np.uint8).reshape(n, rows, cols, 1)
+
+
+def load_idx_labels(path: Path) -> np.ndarray:
+    with _open_maybe_gz(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic}")
+        buf = f.read(n)
+    return np.frombuffer(buf, np.uint8).astype(np.int32)
+
+
+def _find_idx(split: str) -> tuple[Path, Path] | None:
+    stem = "train" if split == "train" else "t10k"
+    for d in _SEARCH_DIRS:
+        if not d:
+            continue
+        base = Path(d)
+        for ext in ("", ".gz"):
+            img = base / f"{stem}-images-idx3-ubyte{ext}"
+            lab = base / f"{stem}-labels-idx1-ubyte{ext}"
+            if img.exists() and lab.exists():
+                return img, lab
+    return None
+
+
+def _normalize(images_u8: np.ndarray) -> np.ndarray:
+    x = images_u8.astype(np.float32) / 255.0
+    return (x - MEAN) / STD
+
+
+def synthetic_mnist(n: int, *, seed: int = 0, n_classes: int = 10) -> Dataset:
+    """Deterministic MNIST-shaped stand-in for zero-egress environments.
+
+    Each class is a fixed smooth random template; samples are
+    template + Gaussian noise, so the task is learnable (a few epochs reach
+    >95% train accuracy with the reference ConvNet) and the loss-decrease /
+    cross-replica-identity integration checks (SURVEY.md §4) behave like
+    the real thing.  NOT the real MNIST — `load_mnist` prefers real IDX
+    files whenever present.
+    """
+    # Class templates come from a FIXED seed so train/test share the same
+    # classes; `seed` only drives the per-sample label/noise draws.
+    trng = np.random.default_rng(42)
+    # Smooth templates: low-res random fields upsampled to 28x28.
+    low = trng.normal(size=(n_classes, 7, 7))
+    templates = low.repeat(4, axis=1).repeat(4, axis=2)
+    templates = (templates - templates.min()) / (np.ptp(templates) + 1e-9)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, n_classes, size=n).astype(np.int32)
+    noise = rng.normal(scale=0.25, size=(n, 28, 28))
+    imgs = np.clip(templates[labels] + noise, 0.0, 1.0).astype(np.float32)
+    imgs_u8 = (imgs * 255).astype(np.uint8)[..., None]
+    return Dataset(_normalize(imgs_u8), labels, synthetic=True)
+
+
+def load_mnist(split: str = "train", *, synthetic_size: int | None = None) -> Dataset:
+    """Load MNIST: real IDX files when available, synthetic otherwise.
+
+    ``synthetic_size`` caps the dataset size on BOTH paths (real data is
+    truncated; the synthetic fallback is generated at that size).  Default:
+    the real split sizes, 60k/10k (train_dist.py:112 assumes 60000).
+    """
+    found = _find_idx(split)
+    if found is not None:
+        imgs = load_idx_images(found[0])
+        labels = load_idx_labels(found[1])
+        if synthetic_size is not None:
+            imgs, labels = imgs[:synthetic_size], labels[:synthetic_size]
+        return Dataset(_normalize(imgs), labels)
+    n = synthetic_size if synthetic_size is not None else (
+        60000 if split == "train" else 10000
+    )
+    return synthetic_mnist(n, seed=0 if split == "train" else 1)

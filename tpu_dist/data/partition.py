@@ -1,0 +1,71 @@
+"""Deterministic dataset partitioning.
+
+Rebuild of the reference's data layer (SURVEY.md §1 L4): an
+index-indirection view (``Partition``, train_dist.py:17-29) plus a seeded
+global-shuffle splitter (``DataPartitioner``, train_dist.py:32-50).
+
+The correctness invariant (SURVEY.md §2c.6): every rank constructs the
+partitioner with the *same seed*, computes the *same* global shuffle, and
+takes its own disjoint fractional slice — disjoint shards with zero
+communication.  We reuse pure-Python ``random.Random(seed)`` exactly so the
+split is identical on every host regardless of accelerator (hard part (d)
+of SURVEY.md §7: no dependence on any framework RNG).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Sequence
+
+
+class Partition:
+    """A view over ``data`` through an index list — ``len``/``getitem``
+    indirection, same contract as train_dist.py:17-29."""
+
+    def __init__(self, data, indices: Sequence[int]):
+        self.data = data
+        self.indices = list(indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)
+
+    def __getitem__(self, i: int):
+        return self.data[self.indices[i]]
+
+
+class DataPartitioner:
+    """Seeded fractional splitter (train_dist.py:32-50 contract).
+
+    ``sizes`` are fractions (default ``[0.7, 0.2, 0.1]`` like the
+    reference); the index list is shuffled once with ``random.Random(seed)``
+    and consumed front-to-back per fraction.  ``use(i)`` returns partition
+    ``i``.  Default seed 1234 — the reference's determinism anchor
+    (train_dist.py:35).
+    """
+
+    def __init__(
+        self,
+        data,
+        sizes: Sequence[float] = (0.7, 0.2, 0.1),
+        seed: int = 1234,
+    ):
+        self.data = data
+        self.partitions: list[list[int]] = []
+        rng = random.Random()
+        rng.seed(seed)
+        indices = list(range(len(data)))
+        rng.shuffle(indices)
+        n = len(data)
+        for frac in sizes:
+            take = int(frac * n)
+            self.partitions.append(indices[:take])
+            indices = indices[take:]
+
+    def use(self, i: int) -> Partition:
+        return Partition(self.data, self.partitions[i])
+
+
+def equal_shards(n_shards: int) -> list[float]:
+    """The training split: equal fractions ``1/world_size``
+    (train_dist.py:86)."""
+    return [1.0 / n_shards] * n_shards

@@ -1,1 +1,20 @@
-"""`tpu_dist.models` — see package modules."""
+"""`tpu_dist.models` — model zoo.
+
+The parity MNIST ConvNet (train_dist.py:53-71 architecture) plus the
+extended-config families: ResNet-18 (CIFAR-10) and ViT-Tiny (ImageNet),
+BASELINE.json configs 4-5.
+"""
+
+from tpu_dist.models.mnist_net import IN_SHAPE, NUM_CLASSES, mnist_net
+from tpu_dist.models.resnet import BasicBlock, resnet18
+from tpu_dist.models.vit import ViT, vit_tiny
+
+__all__ = [
+    "BasicBlock",
+    "IN_SHAPE",
+    "NUM_CLASSES",
+    "ViT",
+    "mnist_net",
+    "resnet18",
+    "vit_tiny",
+]

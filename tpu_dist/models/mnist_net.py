@@ -1,0 +1,36 @@
+"""The MNIST ConvNet — architecture parity with the reference's ``Net``.
+
+train_dist.py:53-71: conv(1→10, k5) → maxpool2 → relu → conv(10→20, k5) →
+dropout2d → maxpool2 → relu → flatten(320) → fc 320→50 → relu → dropout →
+fc 50→10 → log_softmax.  Identical layer graph and sizes here, expressed
+NHWC (TPU-native layout; flatten size 4·4·20 = 320 either way), with
+torch-matching default inits so training dynamics align under the same
+hyperparameters (SGD lr=0.01 momentum=0.5, train_dist.py:110).
+"""
+
+from __future__ import annotations
+
+from tpu_dist import nn
+
+IN_SHAPE = (28, 28, 1)
+NUM_CLASSES = 10
+
+
+def mnist_net() -> nn.Sequential:
+    return nn.Sequential(
+        [
+            nn.Conv2D(10, 5),
+            nn.MaxPool2D(2),
+            nn.relu(),
+            nn.Conv2D(20, 5),
+            nn.Dropout2D(0.5),
+            nn.MaxPool2D(2),
+            nn.relu(),
+            nn.flatten(),  # 4*4*20 = 320
+            nn.Dense(50),
+            nn.relu(),
+            nn.Dropout(0.5),
+            nn.Dense(NUM_CLASSES),
+            nn.log_softmax(),
+        ]
+    )

@@ -1,0 +1,92 @@
+"""ResNet-18 — extended config 4 (BASELINE.json: "ResNet-18 / CIFAR-10,
+larger grads over ICI").
+
+Not in the reference (its only model is the MNIST ConvNet,
+train_dist.py:53-71); included because the survey's extended configs use it
+to stress gradient-allreduce bandwidth (~11M params vs the ConvNet's ~22k).
+CIFAR-style stem (3×3 conv, no max-pool) by default; set ``imagenet_stem``
+for the 7×7/maxpool variant.  NHWC throughout; batch-norm state threads
+through `tpu_dist.nn.core` state handling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist import nn
+from tpu_dist.nn.core import Module
+
+
+class BasicBlock(Module):
+    """Two 3×3 convs + identity (or 1×1-projected) shortcut."""
+
+    def __init__(self, features: int, stride: int = 1):
+        self.features = features
+        self.stride = stride
+        self.conv1 = nn.Conv2D(features, 3, stride=stride, padding=1, use_bias=False)
+        self.bn1 = nn.BatchNorm()
+        self.conv2 = nn.Conv2D(features, 3, padding=1, use_bias=False)
+        self.bn2 = nn.BatchNorm()
+        self.proj = nn.Conv2D(features, 1, stride=stride, use_bias=False)
+        self.bn_proj = nn.BatchNorm()
+
+    def _needs_proj(self, input_shape):
+        return self.stride != 1 or input_shape[-1] != self.features
+
+    def init(self, key, input_shape):
+        ks = jax.random.split(key, 3)
+        p1, s1 = self.conv1.init(ks[0], input_shape)
+        mid_shape = self.conv1.out_shape(input_shape)
+        b1, sb1 = self.bn1.init(ks[0], mid_shape)
+        p2, s2 = self.conv2.init(ks[1], mid_shape)
+        b2, sb2 = self.bn2.init(ks[1], mid_shape)
+        params = {"conv1": p1, "bn1": b1, "conv2": p2, "bn2": b2}
+        state = {"bn1": sb1, "bn2": sb2}
+        if self._needs_proj(input_shape):
+            pp, _ = self.proj.init(ks[2], input_shape)
+            bp, sbp = self.bn_proj.init(ks[2], mid_shape)
+            params["proj"] = pp
+            params["bn_proj"] = bp
+            state["bn_proj"] = sbp
+        return params, state
+
+    def out_shape(self, input_shape):
+        return self.conv2.out_shape(self.conv1.out_shape(input_shape))
+
+    def apply(self, params, state, x, *, train=False, key=None):
+        new_state = dict(state)
+        h, _ = self.conv1.apply(params["conv1"], {}, x)
+        h, new_state["bn1"] = self.bn1.apply(params["bn1"], state["bn1"], h, train=train)
+        h = jax.nn.relu(h)
+        h, _ = self.conv2.apply(params["conv2"], {}, h)
+        h, new_state["bn2"] = self.bn2.apply(params["bn2"], state["bn2"], h, train=train)
+        if "proj" in params:
+            sc, _ = self.proj.apply(params["proj"], {}, x)
+            sc, new_state["bn_proj"] = self.bn_proj.apply(
+                params["bn_proj"], state["bn_proj"], sc, train=train
+            )
+        else:
+            sc = x
+        return jax.nn.relu(h + sc), new_state
+
+
+def resnet18(num_classes: int = 10, *, imagenet_stem: bool = False) -> nn.Sequential:
+    """Standard [2,2,2,2] basic-block ResNet-18."""
+    stem: list[Module] = (
+        [
+            nn.Conv2D(64, 7, stride=2, padding=3, use_bias=False),
+            nn.BatchNorm(),
+            nn.relu(),
+            nn.MaxPool2D(3, 2),
+        ]
+        if imagenet_stem
+        else [nn.Conv2D(64, 3, padding=1, use_bias=False), nn.BatchNorm(), nn.relu()]
+    )
+    blocks: list[Module] = []
+    for stage, features in enumerate((64, 128, 256, 512)):
+        for i in range(2):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            blocks.append(BasicBlock(features, stride))
+    head: list[Module] = [nn.GlobalAvgPool(), nn.Dense(num_classes)]
+    return nn.Sequential(stem + blocks + head)

@@ -8,6 +8,7 @@ parallel ring-attention extension built on the same ring substrate.
 from tpu_dist.parallel.data_parallel import (
     DATA_AXIS,
     average_gradients,
+    make_stateful_train_step,
     make_train_step,
     replicate,
     shard_batch,
@@ -23,6 +24,7 @@ from tpu_dist.parallel.ring import (
 __all__ = [
     "DATA_AXIS",
     "average_gradients",
+    "make_stateful_train_step",
     "make_train_step",
     "replicate",
     "ring_all_gather",

@@ -66,40 +66,77 @@ def make_train_step(
     ``pmean`` — the whole of ``average_gradients`` — is inside the compiled
     program, so XLA overlaps it with the backward pass (the fused design
     required for the 8-chip scaling target, SURVEY.md §7 hard part (e)).
-    """
-    repl = NamedSharding(mesh, P())
-    sharded = NamedSharding(mesh, P(axis_name))
 
-    def spmd_step(params, opt_state, batch, key):
-        # Per-rank rng: fold in the data-parallel rank so e.g. dropout
-        # masks differ across shards (each rank sees different samples).
-        key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params, batch, key
+    Implemented as the stateless special case of `make_stateful_train_step`.
+    """
+
+    def stateful_loss(params, _state, batch, key):
+        loss, aux = loss_fn(params, batch, key)
+        return loss, ((), aux)
+
+    stateful = make_stateful_train_step(
+        stateful_loss, optimizer, mesh, axis_name=axis_name, donate=donate
+    )
+
+    def step(params, opt_state, batch, key):
+        params, _, opt_state, loss, aux = stateful(
+            params, (), opt_state, batch, key
         )
+        return params, opt_state, loss, aux
+
+    return step
+
+
+def _pmean_float_leaves(tree: Any, axis_name: str) -> Any:
+    """pmean floating leaves; pass through non-float leaves (which must be
+    rank-invariant)."""
+    return jax.tree.map(
+        lambda a: lax.pmean(a, axis_name)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+        else a,
+        tree,
+    )
+
+
+def make_stateful_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer,
+    mesh: Mesh,
+    *,
+    axis_name: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Like `make_train_step` but threads non-differentiated model state
+    (e.g. batch-norm running statistics) through the step.
+
+    ``loss_fn(params, model_state, batch, key) -> (loss, (new_state, aux))``.
+    Returns ``step(params, model_state, opt_state, batch, key) ->
+    (params, model_state, opt_state, loss, aux)``.  New state's floating
+    leaves are cross-replica averaged (SyncBN-style statistics), keeping
+    replicas bit-identical — the reference's cross-rank identity invariant
+    (SURVEY.md §2c.6) extended to stateful models.
+    """
+
+    def spmd_step(params, model_state, opt_state, batch, key):
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        (loss, (new_state, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params, model_state, batch, key)
         grads = average_gradients(grads, axis_name)
         loss = lax.pmean(loss, axis_name)
-        # aux is computed per-shard; averaging floating leaves makes the
-        # returned value well-defined globally (metrics become means,
-        # batch-norm statistics become cross-replica means — SyncBN-style).
-        # Non-float leaves (counters, ints) must be rank-invariant.
-        aux = jax.tree.map(
-            lambda a: lax.pmean(a, axis_name)
-            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
-            else a,
-            aux,
-        )
+        new_state = _pmean_float_leaves(new_state, axis_name)
+        aux = _pmean_float_leaves(aux, axis_name)
         params, opt_state = optimizer.update(params, grads, opt_state)
-        return params, opt_state, loss, aux
+        return params, new_state, opt_state, loss, aux
 
     mapped = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name), P()),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(P(), P(), P(), P(axis_name), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+    return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
 
 
 def shard_batch(batch: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:

@@ -1,5 +1,16 @@
-"""`tpu_dist.train` — optimizers, training loop, checkpointing, metrics."""
+"""`tpu_dist.train` — optimizers, trainer, checkpointing, metrics."""
 
+from tpu_dist.train import checkpoint, metrics
 from tpu_dist.train.optim import Optimizer, adamw, sgd
+from tpu_dist.train.trainer import EpochStats, TrainConfig, Trainer
 
-__all__ = ["Optimizer", "adamw", "sgd"]
+__all__ = [
+    "EpochStats",
+    "Optimizer",
+    "TrainConfig",
+    "Trainer",
+    "adamw",
+    "checkpoint",
+    "metrics",
+    "sgd",
+]

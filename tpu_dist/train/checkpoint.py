@@ -1,0 +1,59 @@
+"""Checkpoint / resume.
+
+Absent from the reference (SURVEY.md §5: no save/load anywhere; training
+always starts fresh and runs exactly 10 epochs) — provided here as the
+lightweight single-writer checkpoint the survey prescribes: DP state is
+identical across replicas, so one host writes the pytree once, and resume
+is by epoch index.  Kept off the parity-critical path.
+
+Format: one ``.npz`` per checkpoint holding flattened leaves plus a JSON
+treedef descriptor — no framework-specific serialization, readable with
+plain numpy.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _flatten_with_paths(tree: Any):
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [(jax.tree_util.keystr(path), leaf) for path, leaf in flat[0]]
+    return leaves, flat[1]
+
+
+def save(path: str | Path, tree: Any, *, step: int = 0) -> None:
+    """Single-writer save of a (replicated) pytree.  Only process 0 writes
+    in a multi-process setting — replicas are identical (SURVEY.md §2c.6)."""
+    if jax.process_index() != 0:
+        return
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    leaves, _ = _flatten_with_paths(tree)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, (_, x) in enumerate(leaves)}
+    meta = {"step": step, "paths": [k for k, _ in leaves]}
+    tmp = path.with_suffix(".tmp.npz")
+    np.savez(tmp, __meta__=json.dumps(meta), **arrays)
+    tmp.rename(path)
+
+
+def restore(path: str | Path, like: Any) -> tuple[Any, int]:
+    """Restore into the structure of ``like`` (a template pytree with the
+    same treedef, e.g. freshly-initialized params).  Returns
+    ``(tree, step)``."""
+    path = Path(path)
+    with np.load(path, allow_pickle=False) as data:
+        meta = json.loads(str(data["__meta__"]))
+        leaves_like, treedef = _flatten_with_paths(like)
+        if [k for k, _ in leaves_like] != meta["paths"]:
+            raise ValueError(
+                f"checkpoint {path} structure mismatch: "
+                f"{meta['paths'][:3]}... vs {[k for k, _ in leaves_like][:3]}..."
+            )
+        leaves = [data[f"leaf_{i}"] for i in range(len(meta["paths"]))]
+    return jax.tree_util.tree_unflatten(treedef, leaves), meta["step"]

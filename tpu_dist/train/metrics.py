@@ -1,0 +1,71 @@
+"""Metrics / observability.
+
+The reference's observability is per-rank ``print`` (SURVEY.md §5) plus a
+hand-throttled benchmark loop (allreduce.py:41-42).  We keep that stdout
+surface and add the counters the BASELINE targets need: step timing,
+samples/sec/chip, and achieved collective GB/s, plus `jax.profiler` trace
+hooks for perfetto inspection of ICI overlap.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock step timer with warmup discard (first steps include
+    compilation)."""
+
+    warmup: int = 2
+    times: list = field(default_factory=list)
+    _t0: float = 0.0
+    _count: int = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._count += 1
+        if self._count > self.warmup:
+            self.times.append(time.perf_counter() - self._t0)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / max(len(self.times), 1)
+
+    def samples_per_sec(self, batch: int) -> float:
+        return batch / self.mean if self.times else 0.0
+
+
+def allreduce_gbps(nbytes: int, seconds: float, world: int) -> float:
+    """Achieved ring-allreduce bus bandwidth: each rank moves
+    2·(n-1)/n of the payload (reduce-scatter + all-gather lower bound)."""
+    moved = 2 * (world - 1) / world * nbytes
+    return moved / seconds / 1e9
+
+
+@contextlib.contextmanager
+def trace(dirname: str | None):
+    """`jax.profiler` trace context — perfetto-viewable (SURVEY.md §5
+    tracing equivalent).  No-op when dirname is None."""
+    if dirname is None:
+        yield
+        return
+    jax.profiler.start_trace(dirname)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def block_until_ready(tree):
+    """Barrier for timing: wait for all device work in a pytree."""
+    for leaf in jax.tree.leaves(tree):
+        leaf.block_until_ready()
+    return tree
