@@ -1,0 +1,50 @@
+"""Worker + driver for the true multi-process path (spawn needs a real
+module file).  Run directly: ``python tests/multiproc_worker.py``; the
+slow-marked test in test_multiprocess.py shells out to it."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def psum_worker(rank, world):
+    """Global psum across processes: each process contributes rank+1 from
+    each of its devices' program instances."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("ranks",))
+    f = jax.jit(
+        jax.shard_map(
+            lambda: lax.psum(
+                jnp.float32(jax.process_index() + 1), "ranks"
+            ).reshape(1),
+            mesh=mesh,
+            in_specs=(),
+            out_specs=P("ranks"),
+            check_vma=False,
+        )
+    )
+    out = f()
+    return float(np.asarray(out.addressable_shards[0].data)[0])
+
+
+def main():
+    from tpu_dist.comm.launch import launch
+
+    world, devices_per_proc = 2, 2
+    res = launch(psum_worker, world, platform="cpu", devices_per_proc=devices_per_proc)
+    # devices contribute process_index+1 each: 2*(1) + 2*(2) = 6
+    expect = [6.0] * world
+    assert res == expect, f"{res} != {expect}"
+    print("MULTIPROCESS OK", res)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Native runtime tests: C++ rendezvous (bootstrap contract of
+tuto.md:404-419) and the multi-process launch path."""
+
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from tpu_dist import runtime
+
+REPO = Path(__file__).parent.parent
+
+
+class TestRendezvous:
+    def test_free_port(self):
+        p = runtime.free_port()
+        assert 1024 < p < 65536
+
+    def test_world_one_trivial(self):
+        r, peers = runtime.rendezvous("127.0.0.1", 1, 1, 0, payload="solo")
+        assert r == 0 and peers == {0: "solo"}
+
+    def test_master_worker_with_explicit_ranks(self):
+        port = runtime.free_port()
+        out = {}
+
+        def run(rank):
+            out[rank] = runtime.rendezvous(
+                "127.0.0.1", port, 3, rank, payload=f"p{rank}"
+            )
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert {out[r][0] for r in range(3)} == {0, 1, 2}
+        table = out[0][1]
+        assert table == {0: "p0", 1: "p1", 2: "p2"}
+        assert all(out[r][1] == table for r in range(3))
+
+    def test_rankless_assignment(self):
+        """MPI-style rank-less init (allreduce.py:54 analog): master
+        assigns ranks FCFS."""
+        port = runtime.free_port()
+        out = []
+        lock = threading.Lock()
+
+        def run(is_master):
+            r, peers = runtime.rendezvous(
+                "127.0.0.1", port, 4, 0 if is_master else -1, payload="x"
+            )
+            with lock:
+                out.append(r)
+
+        ts = [threading.Thread(target=run, args=(i == 0,)) for i in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sorted(out) == [0, 1, 2, 3]
+
+    def test_worker_timeout_without_master(self):
+        port = runtime.free_port()
+        with pytest.raises(RuntimeError, match="rendezvous failed"):
+            runtime.rendezvous("127.0.0.1", port, 2, 1, timeout_ms=500)
+
+    def test_master_timeout_without_workers(self):
+        port = runtime.free_port()
+        with pytest.raises(RuntimeError, match="rendezvous failed"):
+            runtime.rendezvous("127.0.0.1", port, 2, 0, timeout_ms=500)
+
+
+@pytest.mark.slow
+def test_multiprocess_psum_end_to_end():
+    """True multi-process collectives: fork-join launcher + native
+    rendezvous + jax.distributed + cross-process psum (2 procs × 2 devs).
+    Runs in a subprocess because jax.distributed can only initialize once
+    per process."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tests" / "multiproc_worker.py")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "MULTIPROCESS OK" in proc.stdout

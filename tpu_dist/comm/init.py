@@ -85,10 +85,34 @@ def init(
         # backend initialization to take effect.
         jax.config.update("jax_platforms", cfg.platform)
     if cfg.num_processes and cfg.num_processes > 1:
-        jax.distributed.initialize(
+        if cfg.coordinator_address is None:
+            raise ValueError(
+                "multi-process init needs MASTER_ADDR/MASTER_PORT (or an "
+                "explicit coordinator_address) — tuto.md:421-428 contract"
+            )
+        addr, _, port_s = cfg.coordinator_address.partition(":")
+        port = int(port_s)
+        # Native bootstrap (tpu_dist/runtime/rendezvous.cc): startup
+        # barrier + rank assignment (process_id=None → master-assigned,
+        # the MPI-style rank-less path of allreduce.py:54).
+        from tpu_dist import runtime
+
+        rank = cfg.process_id if cfg.process_id is not None else -1
+        my_rank, _peers = runtime.rendezvous(
+            addr, port, cfg.num_processes, rank, payload=os.uname().nodename
+        )
+        cfg = InitConfig(
             coordinator_address=cfg.coordinator_address,
             num_processes=cfg.num_processes,
-            process_id=cfg.process_id,
+            process_id=my_rank,
+            platform=cfg.platform,
+        )
+        # Steady-state runtime: XLA's coordination service (one port above
+        # the rendezvous port — both come from the same MASTER contract).
+        jax.distributed.initialize(
+            coordinator_address=f"{addr}:{port + 1}",
+            num_processes=cfg.num_processes,
+            process_id=my_rank,
         )
     _initialized = True
     return cfg

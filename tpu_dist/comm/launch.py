@@ -1,0 +1,108 @@
+"""Multi-process launcher — the fork-join ``__main__`` template, natively
+bootstrapped.
+
+The reference spawns ``size`` local processes, each running
+``init_processes(rank, size, fn)``, then joins them (train_dist.py:138-147
+and the other three scripts).  `launch` reproduces that shape: it forks
+``world`` OS processes with the MASTER_ADDR/PORT/WORLD_SIZE/RANK env
+contract (tuto.md:421-428), each child runs `tpu_dist.comm.init` — whose
+multi-process path does the native C++ rendezvous (startup barrier + rank
+assignment, `tpu_dist.runtime`) and then ``jax.distributed.initialize`` —
+and finally calls ``fn(rank, world)``.
+
+This is the path that scales to one-process-per-TPU-host pods; the same
+launcher with ``platform='cpu'`` is the loopback development harness (the
+reference's fork-over-loopback strategy, SURVEY.md §4.2).  The external
+``mpirun``-style launch (tuto.md:393-398) is covered by setting the env
+vars outside and calling ``init()`` with no arguments (rank -1 lets the
+native rendezvous assign one, mirroring rank-less MPI init,
+allreduce.py:54).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import sys
+import traceback
+from typing import Any, Callable
+
+
+def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc):
+    try:
+        os.environ["MASTER_ADDR"] = addr
+        os.environ["MASTER_PORT"] = str(port)
+        os.environ["WORLD_SIZE"] = str(world)
+        os.environ["RANK"] = str(rank)
+        if platform == "cpu" and devices_per_proc:
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={devices_per_proc}"
+            )
+        from tpu_dist import comm
+
+        comm.init(platform=platform)
+        result = fn(rank, world)
+        conn.send(("ok", pickle.dumps(result)))
+    except BaseException as e:  # report child failures to the parent
+        conn.send(("error", f"rank {rank}: {type(e).__name__}: {e}\n"
+                   f"{traceback.format_exc()}"))
+    finally:
+        conn.close()
+
+
+def launch(
+    fn: Callable[[int, int], Any],
+    world: int,
+    *,
+    platform: str | None = None,
+    addr: str = "127.0.0.1",
+    port: int | None = None,
+    devices_per_proc: int = 1,
+    timeout: float = 300.0,
+) -> list[Any]:
+    """Fork-join ``world`` processes running ``fn(rank, world)``.
+
+    ``fn`` must be picklable (module-level).  Returns each rank's result,
+    index = rank.  Any child failure raises, fail-stop, after terminating
+    the others (the reference's failure model: blocked peers + ``join()``,
+    SURVEY.md §5).
+    """
+    from tpu_dist import runtime
+
+    if port is None:
+        port = runtime.free_port()
+    ctx = mp.get_context("spawn")
+    procs, conns = [], []
+    for rank in range(world):
+        parent_conn, child_conn = ctx.Pipe(duplex=False)
+        p = ctx.Process(
+            target=_child,
+            args=(fn, rank, world, addr, port, platform, child_conn,
+                  devices_per_proc),
+        )
+        p.start()
+        procs.append(p)
+        conns.append(parent_conn)
+    results: list[Any] = [None] * world
+    error = None
+    for rank, (p, conn) in enumerate(zip(procs, conns)):
+        try:
+            if conn.poll(timeout):
+                status, payload = conn.recv()
+                if status == "ok":
+                    results[rank] = pickle.loads(payload)
+                else:
+                    error = error or payload
+            else:
+                error = error or f"rank {rank}: no result within {timeout}s"
+        except EOFError:
+            error = error or f"rank {rank}: died without reporting a result"
+    for p in procs:
+        p.join(timeout=10)
+        if p.is_alive():
+            p.terminate()
+    if error is not None:
+        raise RuntimeError(f"launch failed — {error}")
+    return results

@@ -1,0 +1,109 @@
+"""`tpu_dist.runtime` — native (C++) runtime components.
+
+The reference's native layer is THD's C++ transport/rendezvous
+(tuto.md:404-419); ours is `rendezvous.cc`, loaded via ctypes (no pybind11
+in this image).  The library is built lazily with g++ on first use (or
+``make -C tpu_dist/runtime``) and cached.
+
+API:
+  - `rendezvous(addr, port, world, rank=-1, payload="", timeout_ms=...)`
+    → ``(my_rank, {rank: payload})`` — master/worker bootstrap with rank
+    assignment and a startup barrier.
+  - `free_port()` → an available loopback TCP port.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+_HERE = Path(__file__).parent
+_LIB_PATH = _HERE / "build" / "librendezvous.so"
+_lock = threading.Lock()
+_lib = None
+
+
+def _build() -> Path:
+    subprocess.run(
+        ["make", "-s", "-C", str(_HERE)],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    return _LIB_PATH
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB_PATH.exists():
+            _build()
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.td_rendezvous.restype = ctypes.c_int
+        lib.td_rendezvous.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+        ]
+        lib.td_free_port.restype = ctypes.c_int
+        lib.td_last_error.restype = ctypes.c_char_p
+        _lib = lib
+        return lib
+
+
+def free_port() -> int:
+    port = _load().td_free_port()
+    if port == 0:
+        raise OSError("could not find a free port")
+    return port
+
+
+def rendezvous(
+    addr: str,
+    port: int,
+    world: int,
+    rank: int = -1,
+    payload: str = "",
+    timeout_ms: int = 30_000,
+) -> tuple[int, dict[int, str]]:
+    """Master/worker bootstrap (tuto.md:404-419 contract, natively).
+
+    ``rank=0`` acts as master (binds ``addr:port``); ``rank=-1`` requests
+    master-assigned rank (the MPI-style rank-less init of allreduce.py:54).
+    Blocks until all ``world`` processes have joined (startup barrier) or
+    the timeout elapses — fail-stop, matching the reference's failure model
+    (SURVEY.md §5 'Failure detection').
+
+    Returns ``(my_rank, peer_table)`` where ``peer_table[r]`` is rank r's
+    registered payload string.
+    """
+    lib = _load()
+    buf = ctypes.create_string_buffer(1 << 16)
+    got = lib.td_rendezvous(
+        addr.encode(),
+        port,
+        world,
+        rank,
+        payload.encode(),
+        timeout_ms,
+        buf,
+        len(buf),
+    )
+    if got < 0:
+        err = lib.td_last_error().decode() or "unknown rendezvous failure"
+        raise RuntimeError(f"rendezvous failed (addr={addr}:{port}): {err}")
+    lines = buf.value.decode().strip().split("\n")
+    peers: dict[int, str] = {}
+    for line in lines[1:]:
+        r, _, pl = line.partition(" ")
+        peers[int(r)] = pl
+    return got, peers

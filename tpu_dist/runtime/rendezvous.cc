@@ -1,0 +1,309 @@
+// Native rendezvous — the framework's bootstrap transport.
+//
+// TPU-native equivalent of the reference's THD TCP-channel rendezvous
+// (documented at /root/reference/tuto.md:404-419): rank 0 acts as master,
+// binds MASTER_ADDR:MASTER_PORT, waits for exactly world_size-1 workers,
+// collects each worker's location record, and sends every participant the
+// full peer table; workers connect, register, and receive the table.  It
+// also covers the MPI-style rank-less init (the reference's
+// allreduce.py:54 path, where the launcher assigns ranks): processes that
+// pass rank = -1 are assigned ranks first-come-first-served by the master.
+//
+// The Python layer (tpu_dist/runtime/__init__.py) uses this to realize the
+// MASTER_ADDR/PORT/WORLD_SIZE/RANK env-var contract before handing the
+// established process set to jax.distributed.initialize (whose coordinator
+// then plays the steady-state role; this component owns process bootstrap,
+// rank assignment, and the startup barrier).
+//
+// Build: make -C tpu_dist/runtime   (produces librendezvous.so, loaded via
+// ctypes — no pybind11 dependency).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxMsg = 1 << 16;
+
+// Last error message, readable from Python via td_last_error().
+thread_local char g_err[512] = {0};
+
+void set_err(const char* where) {
+  snprintf(g_err, sizeof(g_err), "%s: %s", where, strerror(errno));
+}
+
+void set_errmsg(const char* msg) { snprintf(g_err, sizeof(g_err), "%s", msg); }
+
+int set_timeout(int fd, int timeout_ms) {
+  timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv)) < 0) return -1;
+  if (setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv)) < 0) return -1;
+  return 0;
+}
+
+// Length-prefixed message framing (4-byte big-endian length + payload).
+int send_msg(int fd, const std::string& payload) {
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  if (write(fd, &len, 4) != 4) return -1;
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t n = write(fd, payload.data() + off, payload.size() - off);
+    if (n <= 0) return -1;
+    off += static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+int recv_msg(int fd, std::string* out) {
+  uint32_t len_be = 0;
+  size_t got = 0;
+  char* p = reinterpret_cast<char*>(&len_be);
+  while (got < 4) {
+    ssize_t n = read(fd, p + got, 4 - got);
+    if (n <= 0) return -1;
+    got += static_cast<size_t>(n);
+  }
+  uint32_t len = ntohl(len_be);
+  if (len > kMaxMsg) return -1;
+  out->resize(len);
+  got = 0;
+  while (got < len) {
+    ssize_t n = read(fd, out->data() + got, len - got);
+    if (n <= 0) return -1;
+    got += static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+int connect_to(const char* addr, int port, int timeout_ms) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    set_err("socket");
+    return -1;
+  }
+  set_timeout(fd, timeout_ms);
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) {
+    set_errmsg("inet_pton: bad address");
+    close(fd);
+    return -1;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+    set_err("connect");
+    close(fd);
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns a free TCP port on the loopback interface (0 on failure).
+int td_free_port() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 0;
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = 0;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+    close(fd);
+    return 0;
+  }
+  socklen_t len = sizeof(sa);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
+  int port = ntohs(sa.sin_port);
+  close(fd);
+  return port;
+}
+
+const char* td_last_error() { return g_err; }
+
+// Master side: bind addr:port, accept (world-1) workers, assign ranks,
+// broadcast the peer table.  Returns 0 on success.
+//
+// Peer table format (what lands in peers_out for every participant):
+//   "<world>\n<rank> <payload>\n..." — payload is the opaque per-process
+//   string each participant registered (e.g. "host:port" or a coordinator
+//   hint); master's payload is its own `payload` argument.
+static int run_master(const char* addr, int port, int world,
+                      const char* payload, int timeout_ms, char* peers_out,
+                      int cap) {
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) {
+    set_err("socket");
+    return -1;
+  }
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, addr, &sa.sin_addr) != 1) {
+    set_errmsg("inet_pton: bad address");
+    close(lfd);
+    return -1;
+  }
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+    set_err("bind");
+    close(lfd);
+    return -1;
+  }
+  if (listen(lfd, world) < 0) {
+    set_err("listen");
+    close(lfd);
+    return -1;
+  }
+  set_timeout(lfd, timeout_ms);
+
+  std::vector<std::string> payloads(static_cast<size_t>(world));
+  payloads[0] = payload;
+  std::vector<int> fds;
+  std::vector<int> ranks;
+  int next_rank = 1;
+  // Wait for exactly world-1 workers (the reference master "waits for all
+  // processes to connect", tuto.md:412-414) — fail-stop on timeout.
+  for (int i = 0; i < world - 1; ++i) {
+    int cfd = accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      set_err("accept (startup barrier timeout?)");
+      for (int fd : fds) close(fd);
+      close(lfd);
+      return -1;
+    }
+    set_timeout(cfd, timeout_ms);
+    std::string hello;
+    if (recv_msg(cfd, &hello) != 0) {
+      set_errmsg("recv hello failed");
+      close(cfd);
+      for (int fd : fds) close(fd);
+      close(lfd);
+      return -1;
+    }
+    // hello = "<requested_rank> <payload>"
+    int req = -1;
+    size_t sp = hello.find(' ');
+    std::string wpayload = sp == std::string::npos ? "" : hello.substr(sp + 1);
+    req = atoi(hello.c_str());
+    int r = req >= 0 ? req : next_rank++;
+    while (req < 0 && r < world && !payloads[static_cast<size_t>(r)].empty())
+      r = next_rank++;
+    if (r <= 0 || r >= world || !payloads[static_cast<size_t>(r)].empty()) {
+      set_errmsg("rank collision or out of range during rendezvous");
+      close(cfd);
+      for (int fd : fds) close(fd);
+      close(lfd);
+      return -1;
+    }
+    payloads[static_cast<size_t>(r)] = wpayload;
+    fds.push_back(cfd);
+    ranks.push_back(r);
+  }
+  std::string table = std::to_string(world) + "\n";
+  for (int r = 0; r < world; ++r)
+    table += std::to_string(r) + " " + payloads[static_cast<size_t>(r)] + "\n";
+  for (size_t i = 0; i < fds.size(); ++i) {
+    std::string msg = std::to_string(ranks[i]) + "\n" + table;
+    if (send_msg(fds[i], msg) != 0) {
+      set_errmsg("send table failed");
+      for (int fd : fds) close(fd);
+      close(lfd);
+      return -1;
+    }
+  }
+  for (int fd : fds) close(fd);
+  close(lfd);
+  if (static_cast<int>(table.size()) + 1 > cap) {
+    set_errmsg("peers_out buffer too small");
+    return -1;
+  }
+  memcpy(peers_out, table.c_str(), table.size() + 1);
+  return 0;  // master is rank 0
+}
+
+// td_rendezvous: returns the caller's rank (>= 0) on success, -1 on error.
+//   rank: requested rank; 0 = act as master; -1 = let the master assign
+//         (MPI-style rank-less init, allreduce.py:54 analog).
+//   payload: opaque per-process record shared with all peers.
+//   peers_out/cap: receives the peer table (see run_master).
+int td_rendezvous(const char* addr, int port, int world, int rank,
+                  const char* payload, int timeout_ms, char* peers_out,
+                  int cap) {
+  g_err[0] = 0;
+  if (world < 1) {
+    set_errmsg("world must be >= 1");
+    return -1;
+  }
+  if (world == 1) {
+    std::string table = "1\n0 " + std::string(payload) + "\n";
+    if (static_cast<int>(table.size()) + 1 > cap) {
+      set_errmsg("peers_out buffer too small");
+      return -1;
+    }
+    memcpy(peers_out, table.c_str(), table.size() + 1);
+    return 0;
+  }
+  if (rank == 0) {
+    return run_master(addr, port, world, payload, timeout_ms, peers_out, cap);
+  }
+  // Worker: retry connecting until the master is up (or timeout).
+  timeval start{};
+  gettimeofday(&start, nullptr);
+  int fd = -1;
+  for (;;) {
+    fd = connect_to(addr, port, timeout_ms);
+    if (fd >= 0) break;
+    timeval now{};
+    gettimeofday(&now, nullptr);
+    long elapsed_ms = (now.tv_sec - start.tv_sec) * 1000 +
+                      (now.tv_usec - start.tv_usec) / 1000;
+    if (elapsed_ms > timeout_ms) {
+      set_errmsg("worker: master did not come up before timeout");
+      return -1;
+    }
+    usleep(50 * 1000);
+  }
+  std::string hello = std::to_string(rank) + " " + payload;
+  if (send_msg(fd, hello) != 0) {
+    set_errmsg("worker: send hello failed");
+    close(fd);
+    return -1;
+  }
+  std::string reply;
+  if (recv_msg(fd, &reply) != 0) {
+    set_errmsg("worker: recv table failed (startup barrier timeout?)");
+    close(fd);
+    return -1;
+  }
+  close(fd);
+  size_t nl = reply.find('\n');
+  if (nl == std::string::npos) {
+    set_errmsg("worker: malformed reply");
+    return -1;
+  }
+  int my_rank = atoi(reply.c_str());
+  std::string table = reply.substr(nl + 1);
+  if (static_cast<int>(table.size()) + 1 > cap) {
+    set_errmsg("peers_out buffer too small");
+    return -1;
+  }
+  memcpy(peers_out, table.c_str(), table.size() + 1);
+  return my_rank;
+}
+
+}  // extern "C"
