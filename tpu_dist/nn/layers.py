@@ -42,6 +42,15 @@ class Dense(Module):
         return input_shape[:-1] + (self.features,)
 
     def apply(self, params, state, x, *, train=False, key=None):
+        # Optional hand-tuned path: fused pallas matmul (+bias) kernel for
+        # 2-D activations (TPU_DIST_PALLAS_DENSE=1); default is XLA's dot,
+        # which it tiles onto the MXU itself.
+        from tpu_dist.ops.matmul import use_pallas_dense
+
+        if self.use_bias and x.ndim == 2 and use_pallas_dense():
+            from tpu_dist.ops.matmul import matmul
+
+            return matmul(x, params["w"], params["b"]), state
         y = x @ params["w"]
         if self.use_bias:
             y = y + params["b"]

@@ -1,1 +1,13 @@
-"""`tpu_dist.ops` — see package modules."""
+"""`tpu_dist.ops` — Pallas TPU kernels (the hot-op / native-kernel layer).
+
+- `matmul`: tiled MXU matmul with fused bias+activation epilogue
+  (interpret-mode testable on CPU).
+- `ring_all_reduce_pallas`: the hand-rolled ring allreduce at the RDMA
+  level (the reference's allreduce.py exercise at its native depth);
+  TPU-only, ppermute fallback elsewhere.
+"""
+
+from tpu_dist.ops.matmul import matmul, use_pallas_dense
+from tpu_dist.ops.pallas_ring import ring_all_reduce_pallas
+
+__all__ = ["matmul", "ring_all_reduce_pallas", "use_pallas_dense"]
