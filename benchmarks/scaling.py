@@ -1,0 +1,112 @@
+"""Scaling-efficiency harness — the 1→N-chip target (BASELINE.md: ≥90%
+efficiency 1→8 chips on the MNIST DP workload).
+
+Measures fused-train-step throughput at world sizes 1, 2, 4, ..., N with
+CONSTANT per-chip batch (weak scaling — the regime where the gradient
+allreduce is the only added cost, so efficiency isolates interconnect +
+compile quality).  Prints a table plus one JSON line for machines.
+
+Run: ``python benchmarks/scaling.py [--platform cpu] [--batch-per-chip N]``
+(CPU simulation exercises the harness; the numbers that matter come from
+real chips, where ICI carries the pmean.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure(world: int, batch_per_chip: int, steps: int, platform: str | None):
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import comm, models, nn, parallel, train
+
+    mesh = comm.make_mesh(world, ("data",), platform=platform)
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+    opt = train.sgd(0.01, momentum=0.5)
+
+    def loss_fn(p, s, batch, key):
+        x, y = batch
+        scores, s2 = model.apply(p, s, x, train=True, key=key)
+        return nn.nll_loss(scores, y), (s2, {})
+
+    step = parallel.make_stateful_train_step(loss_fn, opt, mesh)
+    p = parallel.replicate(params, mesh)
+    ms = parallel.replicate(state, mesh)
+    os_ = parallel.replicate(opt.init(params), mesh)
+    global_batch = batch_per_chip * world
+    batch = parallel.shard_batch(
+        (
+            jnp.zeros((global_batch,) + models.IN_SHAPE, jnp.float32),
+            jnp.zeros((global_batch,), jnp.int32),
+        ),
+        mesh,
+    )
+    key = jax.random.key(1)
+    for _ in range(3):
+        p, ms, os_, loss, _ = step(p, ms, os_, batch, key)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, ms, os_, loss, _ = step(p, ms, os_, batch, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return steps * global_batch / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--batch-per-chip", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--max-world", type=int, default=None)
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.max_world or 8}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    n_dev = len(jax.devices(args.platform) if args.platform else jax.devices())
+    max_world = min(args.max_world or n_dev, n_dev)
+    worlds = [w for w in (1, 2, 4, 8, 16, 32) if w <= max_world]
+
+    results = {}
+    for w in worlds:
+        sps = measure(w, args.batch_per_chip, args.steps, args.platform)
+        results[w] = sps
+        print(
+            f"world={w:3d}  {sps:12,.0f} samples/s  "
+            f"({sps / w:10,.0f} /chip)",
+            file=sys.stderr,
+        )
+    base = results[worlds[0]]
+    table = {
+        str(w): {
+            "samples_per_sec": round(results[w], 1),
+            "efficiency": round(results[w] / (base * w / worlds[0]), 4),
+        }
+        for w in worlds
+    }
+    eff_last = table[str(worlds[-1])]["efficiency"]
+    print(
+        f"scaling efficiency {worlds[0]}->{worlds[-1]}: {eff_last:.1%}",
+        file=sys.stderr,
+    )
+    print(json.dumps({"metric": "dp_weak_scaling", "worlds": table}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""Watchdog + aliasing checks (the race/deadlock-analog tooling)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_dist import utils
+
+
+def test_watchdog_quiet_on_fast_block():
+    with utils.collective_watchdog(timeout_s=5.0, what="fast") as fired:
+        jax.block_until_ready(jnp.ones(4) + 1)
+    assert not fired.is_set()
+
+
+def test_watchdog_fires_on_slow_block(capsys):
+    with utils.collective_watchdog(timeout_s=0.05, what="slow-thing") as fired:
+        time.sleep(0.3)
+    assert fired.is_set()
+
+
+def test_blocked_until_ready_passthrough():
+    x = utils.blocked_until_ready(jnp.arange(3.0), timeout_s=5.0)
+    assert float(x.sum()) == 3.0
+
+
+def test_assert_no_aliasing_detects_shared_buffer():
+    x = jnp.ones(4)
+    with pytest.raises(ValueError, match="aliased"):
+        utils.assert_no_aliasing({"a": x}, {"b": x})
+
+
+def test_assert_no_aliasing_detects_donated_buffer():
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    donating = jax.jit(lambda x: x * 2, donate_argnums=0)
+    x = jnp.ones(8)
+    x = jax.device_put(x)
+    donating(x)  # consumes x
+    with pytest.raises(ValueError, match="donated"):
+        utils.assert_no_aliasing({"x": x})
+
+
+def test_assert_no_aliasing_ok_on_distinct():
+    utils.assert_no_aliasing({"a": jnp.ones(3)}, {"b": jnp.zeros(3)})

@@ -10,7 +10,7 @@ from tpu_dist import comm, parallel
 from tpu_dist.nn import dot_product_attention
 
 N = 4
-B, H, S_LOCAL, D = 2, 2, 3, 8
+B, H, S_LOCAL, D = 2, 2, 4, 8
 S = N * S_LOCAL
 
 
@@ -38,6 +38,34 @@ def test_ring_attention_matches_full(causal):
     out = np.asarray(run(fn, q, k, v, world=N))  # (N, B, H, S_LOCAL, D)
     gathered = np.concatenate([out[r] for r in range(N)], axis=2)
     np.testing.assert_allclose(gathered, np.asarray(full), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_mha_module_matches_dense_module(causal):
+    """Same params, sharded vs unsharded module → same output."""
+    from tpu_dist import nn
+
+    dim, heads = 16, 4
+    dense = nn.MultiHeadAttention(dim, heads, causal=causal)
+    params, _ = dense.init(jax.random.key(0), (S, dim))
+    x = jax.random.normal(jax.random.key(1), (B, S, dim))
+    y_dense, _ = dense.apply(params, {}, x)
+
+    ring = parallel.RingMultiHeadAttention(
+        dim, heads, axis_name=comm.DEFAULT_AXIS, causal=causal
+    )
+
+    def fn(params, x):
+        r = comm.rank()
+        x_local = jax.lax.dynamic_slice_in_dim(x, r * S_LOCAL, S_LOCAL, 1)
+        y, _ = ring.apply(params, {}, x_local)
+        return y
+
+    out = np.asarray(run(fn, params, x, world=N))  # (N, B, S_LOCAL, dim)
+    gathered = np.concatenate([out[r] for r in range(N)], axis=1)
+    np.testing.assert_allclose(
+        gathered, np.asarray(y_dense), rtol=2e-4, atol=2e-5
+    )
 
 
 def test_ring_attention_single_device():

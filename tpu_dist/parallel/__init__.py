@@ -13,7 +13,17 @@ from tpu_dist.parallel.data_parallel import (
     replicate,
     shard_batch,
 )
-from tpu_dist.parallel.ring_attention import ring_attention
+from tpu_dist.parallel.ring_attention import (
+    RingMultiHeadAttention,
+    ring_attention,
+)
+from tpu_dist.parallel.tensor_parallel import (
+    MODEL_AXIS,
+    column_parallel,
+    row_parallel,
+    shard_dim,
+    tp_mlp,
+)
 from tpu_dist.parallel.ring import (
     ring_all_gather,
     ring_all_reduce,
@@ -23,7 +33,13 @@ from tpu_dist.parallel.ring import (
 
 __all__ = [
     "DATA_AXIS",
+    "MODEL_AXIS",
+    "RingMultiHeadAttention",
     "average_gradients",
+    "column_parallel",
+    "row_parallel",
+    "shard_dim",
+    "tp_mlp",
     "make_stateful_train_step",
     "make_train_step",
     "replicate",

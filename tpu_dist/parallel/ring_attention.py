@@ -106,3 +106,42 @@ def ring_attention(
 
     (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
     return (acc / l[..., None]).astype(q.dtype)
+
+
+class RingMultiHeadAttention:
+    """Sequence-parallel MHA module: drop-in for
+    `tpu_dist.nn.MultiHeadAttention` inside shard_map'd code whose inputs
+    are sequence shards over ``axis_name``.
+
+    QKV/out projections are token-local (no communication); only the
+    attention core rotates K/V blocks around the ring.  Init is identical
+    to the dense module's, so the same checkpoint runs sharded or not —
+    tests assert numerical agreement with the unsharded module.
+    """
+
+    def __init__(self, dim: int, heads: int, *, axis_name: str, causal: bool = False):
+        from tpu_dist import nn  # local import: nn must not depend on parallel
+
+        self.axis_name = axis_name
+        self.causal = causal
+        self._dense = nn.MultiHeadAttention(dim, heads, causal=causal)
+        self.dim = dim
+        self.heads = heads
+        self.head_dim = dim // heads
+
+    def init(self, key, input_shape):
+        return self._dense.init(key, input_shape)
+
+    def out_shape(self, input_shape):
+        return input_shape
+
+    def apply(self, params, state, x, *, train=False, key=None):
+        d = self._dense
+        b, s_local, _ = x.shape
+        qkv, _ = d._qkv.apply(params["qkv"], {}, x)
+        qkv = qkv.reshape(b, s_local, 3, self.heads, self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        o = ring_attention(q, k, v, self.axis_name, causal=self.causal)
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, self.dim)
+        y, _ = d._out.apply(params["out"], {}, o)
+        return y, state

@@ -1,0 +1,76 @@
+"""Tensor (model) parallelism helpers.
+
+The reference has no TP (SURVEY.md §2d: "non-goal for parity; design the
+mesh API so a `model` axis is expressible").  This module makes that
+expressibility concrete with the two canonical sharded-matmul forms, so a
+2-D ``('data', 'model')`` mesh is a working configuration, not a claim:
+
+- `column_parallel`: weights split on the OUTPUT dim; each rank computes
+  its slice of the output; no communication (activations replicated in,
+  sharded out).
+- `row_parallel`: weights split on the INPUT dim; each rank contributes a
+  partial product; one ``psum`` over the model axis completes the matmul
+  (sharded in, replicated out).
+
+The Megatron pattern — column-parallel up-projection, row-parallel
+down-projection, one collective per MLP block — is `tp_mlp`, tested
+against the unsharded computation on a 2-D mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+MODEL_AXIS = "model"
+
+
+def shard_dim(w: jax.Array, axis_name: str, dim: int) -> jax.Array:
+    """Slice this rank's piece of a replicated weight along ``dim`` —
+    helper for entering shard_map'd TP code with replicated params."""
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    if w.shape[dim] % n:
+        raise ValueError(
+            f"dim {dim} of shape {w.shape} not divisible by axis size {n}"
+        )
+    piece = w.shape[dim] // n
+    return lax.dynamic_slice_in_dim(w, r * piece, piece, dim)
+
+
+def column_parallel(
+    x: jax.Array, w_shard: jax.Array, axis_name: str = MODEL_AXIS
+) -> jax.Array:
+    """x @ W with W column-sharded: returns this rank's output slice
+    (no communication)."""
+    return x @ w_shard
+
+
+def row_parallel(
+    x_shard: jax.Array, w_shard: jax.Array, axis_name: str = MODEL_AXIS
+) -> jax.Array:
+    """x @ W with W row-sharded and x correspondingly column-sharded:
+    psum of partial products -> replicated output (ONE collective)."""
+    return lax.psum(x_shard @ w_shard, axis_name)
+
+
+def tp_mlp(
+    x: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    axis_name: str = MODEL_AXIS,
+    *,
+    activation=jax.nn.gelu,
+) -> jax.Array:
+    """Megatron-style MLP: gelu(x @ W_up) @ W_down with ONE psum total.
+
+    ``w_up``/``w_down`` are passed replicated; each rank slices its shard
+    (cols of W_up, rows of W_down).  The activation applies to the
+    column-sharded hidden states, so no communication happens between the
+    two matmuls.
+    """
+    up = shard_dim(w_up, axis_name, 1)
+    down = shard_dim(w_down, axis_name, 0)
+    hidden = activation(column_parallel(x, up, axis_name))
+    return row_parallel(hidden, down, axis_name)

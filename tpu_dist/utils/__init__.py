@@ -1,5 +1,10 @@
-"""`tpu_dist.utils` — pytree and misc helpers."""
+"""`tpu_dist.utils` — pytree helpers and debug tooling."""
 
+from tpu_dist.utils.debug import (
+    assert_no_aliasing,
+    blocked_until_ready,
+    collective_watchdog,
+)
 from tpu_dist.utils.tree import (
     global_norm,
     tree_allclose,
@@ -9,6 +14,9 @@ from tpu_dist.utils.tree import (
 )
 
 __all__ = [
+    "assert_no_aliasing",
+    "blocked_until_ready",
+    "collective_watchdog",
     "global_norm",
     "tree_allclose",
     "tree_bytes",

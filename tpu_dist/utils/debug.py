@@ -1,0 +1,87 @@
+"""Debug tooling — the race/deadlock analog (SURVEY.md §5).
+
+JAX's functional model removes the reference's data race by construction
+(reading after ``irecv`` before ``wait()``, tuto.md:114-120, is
+unrepresentable: un-arrived values don't exist in the dataflow graph).
+The real distributed failure mode that remains is a *stalled collective* —
+a peer that never enters the program (the reference analog: the master
+blocking until every worker connects), or mismatched program order across
+hosts.  `collective_watchdog` turns that silent hang into a loud,
+explained one.
+
+`assert_no_aliasing` guards the other sharp edge of compiled training
+loops: donated buffers (``donate_argnums``) must not be reused by the
+caller after the step.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+
+import jax
+
+
+@contextlib.contextmanager
+def collective_watchdog(timeout_s: float = 120.0, what: str = "device program"):
+    """Context manager that screams (stderr) if the wrapped block doesn't
+    finish within ``timeout_s`` — likely a stalled collective (missing
+    peer process, mismatched collective order across hosts, or a dead
+    interconnect link).  The block is NOT killed (XLA offers no safe
+    cancel); the message tells the operator what to look at, turning an
+    indefinite silent hang into a diagnosed one."""
+    fired = threading.Event()
+    done = threading.Event()
+
+    def watch():
+        if not done.wait(timeout_s):
+            fired.set()
+            print(
+                f"[tpu_dist watchdog] '{what}' has not completed after "
+                f"{timeout_s:.0f}s — likely a stalled collective. Check: "
+                f"(1) did all {jax.process_count()} processes reach this "
+                f"step? (2) do all hosts run the same program (same "
+                f"collective order)? (3) interconnect health. The wait "
+                f"continues; Ctrl-C to abort.",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    try:
+        yield fired
+    finally:
+        done.set()
+
+
+def blocked_until_ready(tree, *, timeout_s: float = 120.0, what: str = "step"):
+    """``jax.block_until_ready`` under the watchdog."""
+    with collective_watchdog(timeout_s, what):
+        return jax.block_until_ready(tree)
+
+
+def assert_no_aliasing(*trees) -> None:
+    """Raise if any two leaves across the given pytrees share a buffer —
+    catches accidental reuse of donated arrays (the donation/aliasing
+    check SURVEY.md §5 prescribes)."""
+    seen: dict[int, str] = {}
+    for ti, tree in enumerate(trees):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if not isinstance(leaf, jax.Array):
+                continue
+            if leaf.is_deleted():
+                raise ValueError(
+                    f"tree {ti} leaf {jax.tree_util.keystr(path)} is a "
+                    f"deleted (donated) buffer — it was consumed by a "
+                    f"donating jit call and must not be reused"
+                )
+            key = id(leaf)
+            where = f"tree {ti} leaf {jax.tree_util.keystr(path)}"
+            if key in seen:
+                raise ValueError(
+                    f"aliased arrays: {where} and {seen[key]} are the same "
+                    f"buffer; donation would invalidate both"
+                )
+            seen[key] = where
