@@ -1,0 +1,53 @@
+"""Extended-config training: ResNet-18 / CIFAR-10 and ViT — BASELINE.json
+configs 4-5 ("larger grads over ICI" / "stress allreduce bandwidth").
+
+Same data-parallel machinery as `demos/train_dist.py`, bigger gradients:
+the MNIST net all-reduces ~87 KB of grads per step, ResNet-18 ~45 MB —
+this is the workload that exercises ICI bandwidth.  Mixed precision
+(`--bf16`) runs the matmuls MXU-native with f32 master weights.
+"""
+
+from _common import parse_args
+
+
+def main():
+    args = parse_args(
+        default_world=None,
+        model=(str, "resnet18", "resnet18 | vit"),
+        epochs=(int, 2, "training epochs"),
+        samples=(int, 4096, "cap dataset size (0 = full)"),
+        batch=(int, 128, "global batch size"),
+        bf16=(int, 0, "1 = bfloat16 compute, f32 master weights"),
+    )
+    from tpu_dist import comm, data, models, nn, train
+
+    world = args.world or len(comm.devices(args.platform))
+    mesh = comm.make_mesh(world, ("data",), platform=args.platform)
+    ds = data.load_cifar10("train", limit=args.samples or None)
+    kind = "synthetic" if ds.synthetic else "real"
+
+    if args.model == "resnet18":
+        model, in_shape = models.resnet18(num_classes=10), (32, 32, 3)
+    elif args.model == "vit":
+        model, in_shape = models.vit_tiny(image_size=32, patch=4, num_classes=10), (32, 32, 3)
+    else:
+        raise SystemExit(f"unknown --model {args.model!r}")
+
+    print(f"{args.model} on CIFAR-10 ({kind}, {len(ds)} samples), "
+          f"{world} ranks [{mesh.devices.flat[0].platform}]"
+          f"{' bf16' if args.bf16 else ''}")
+    cfg = train.TrainConfig(
+        epochs=args.epochs,
+        global_batch=args.batch,
+        lr=0.05,
+        momentum=0.9,
+        compute_dtype="bfloat16" if args.bf16 else None,
+    )
+    trainer = train.Trainer(model, in_shape, mesh, cfg, loss=nn.cross_entropy)
+    trainer.fit(ds)
+    test = data.load_cifar10("test", limit=min(2000, len(ds)) if ds.synthetic else None)
+    print(f"Test accuracy: {trainer.evaluate(test, batch_size=500):.4f}")
+
+
+if __name__ == "__main__":
+    main()

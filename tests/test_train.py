@@ -40,6 +40,21 @@ def test_training_is_deterministic(mesh, dataset):
     )
 
 
+def test_bf16_compute_and_remat(mesh, dataset):
+    """Mixed precision + remat: trains (loss decreases), master weights
+    stay f32."""
+    import jax.numpy as jnp
+
+    cfg = train.TrainConfig(
+        epochs=2, compute_dtype="bfloat16", remat=True, log=lambda s: None
+    )
+    t = train.Trainer(models.mnist_net(), models.IN_SHAPE, mesh, cfg)
+    hist = t.fit(dataset)
+    assert hist[-1].mean_loss < hist[0].mean_loss
+    for leaf in jax.tree.leaves(t.params):
+        assert leaf.dtype == jnp.float32
+
+
 def test_evaluate_runs(mesh, dataset):
     t = _make_trainer(mesh, epochs=1)
     t.fit(dataset)

@@ -1,5 +1,6 @@
 """`tpu_dist.data` — partitioning and loading (SURVEY.md §1 L4)."""
 
+from tpu_dist.data.cifar import load_cifar10, synthetic_cifar10
 from tpu_dist.data.loader import DistributedLoader, Loader
 from tpu_dist.data.mnist import (
     Dataset,
@@ -17,8 +18,10 @@ __all__ = [
     "Loader",
     "Partition",
     "equal_shards",
+    "load_cifar10",
     "load_idx_images",
     "load_idx_labels",
     "load_mnist",
+    "synthetic_cifar10",
     "synthetic_mnist",
 ]

@@ -1,0 +1,83 @@
+"""CIFAR-10 — extended config 4's dataset (BASELINE.json: "ResNet-18 /
+CIFAR-10 ... larger grads over ICI").
+
+Reads the standard binary format (``data_batch_*.bin`` / ``test_batch.bin``:
+10000 records of 1 label byte + 3072 channel-major pixel bytes) from
+``$TPU_DIST_DATA_DIR``/common locations; falls back to the deterministic
+synthetic generator (same scheme as `tpu_dist.data.mnist.synthetic_mnist`,
+32×32×3) in zero-egress environments.  NHWC float32, per-channel
+normalized.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from tpu_dist.data.mnist import Dataset
+
+MEAN = np.array([0.4914, 0.4822, 0.4465], np.float32)
+STD = np.array([0.2470, 0.2435, 0.2616], np.float32)
+
+_SEARCH_DIRS = (
+    os.environ.get("TPU_DIST_DATA_DIR", ""),
+    "data/cifar10",
+    "data/cifar-10-batches-bin",
+    os.path.expanduser("~/data/cifar10"),
+)
+
+
+def _parse_bin(path: Path) -> tuple[np.ndarray, np.ndarray]:
+    raw = np.frombuffer(path.read_bytes(), np.uint8)
+    rec = 1 + 3072
+    if raw.size % rec:
+        raise ValueError(f"{path}: not a CIFAR-10 binary batch (size {raw.size})")
+    raw = raw.reshape(-1, rec)
+    labels = raw[:, 0].astype(np.int32)
+    # channel-major (3, 32, 32) -> NHWC
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return imgs, labels
+
+
+def _normalize(imgs_u8: np.ndarray) -> np.ndarray:
+    return (imgs_u8.astype(np.float32) / 255.0 - MEAN) / STD
+
+
+def synthetic_cifar10(n: int, *, seed: int = 0) -> Dataset:
+    """Deterministic CIFAR-shaped stand-in (fixed class templates + noise;
+    see `tpu_dist.data.mnist.synthetic_mnist` for the scheme)."""
+    trng = np.random.default_rng(4242)
+    low = trng.normal(size=(10, 8, 8, 3))
+    templates = low.repeat(4, axis=1).repeat(4, axis=2)
+    templates = (templates - templates.min()) / (np.ptp(templates) + 1e-9)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    noise = rng.normal(scale=0.25, size=(n, 32, 32, 3))
+    imgs = np.clip(templates[labels] + noise, 0.0, 1.0)
+    return Dataset(
+        _normalize((imgs * 255).astype(np.uint8)), labels, synthetic=True
+    )
+
+
+def load_cifar10(split: str = "train", *, limit: int | None = None) -> Dataset:
+    files = (
+        [f"data_batch_{i}.bin" for i in range(1, 6)]
+        if split == "train"
+        else ["test_batch.bin"]
+    )
+    for d in _SEARCH_DIRS:
+        if not d:
+            continue
+        base = Path(d)
+        paths = [base / f for f in files]
+        if all(p.exists() for p in paths):
+            parts = [_parse_bin(p) for p in paths]
+            imgs = np.concatenate([p[0] for p in parts])
+            labels = np.concatenate([p[1] for p in parts])
+            if limit is not None:
+                imgs, labels = imgs[:limit], labels[:limit]
+            return Dataset(_normalize(imgs), labels)
+    n = limit if limit is not None else (50000 if split == "train" else 10000)
+    return synthetic_cifar10(n, seed=0 if split == "train" else 1)

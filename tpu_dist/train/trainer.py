@@ -43,6 +43,12 @@ class TrainConfig:
     momentum: float = 0.5
     seed: int = 1234
     log: Callable[[str], None] = print
+    # TPU performance knobs (defaults preserve reference-exact numerics):
+    # compute_dtype='bfloat16' runs forward/backward matmuls MXU-native
+    # with f32 master weights and f32 loss/grad accumulation; remat
+    # rematerializes the forward in the backward pass (HBM for FLOPs).
+    compute_dtype: str | None = None
+    remat: bool = False
 
 
 @dataclass
@@ -80,11 +86,34 @@ class Trainer:
         self.model_state = parallel.replicate(state, mesh)
         self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
 
-        def loss_fn(params, model_state, batch, key):
-            x, y = batch
+        compute_dtype = (
+            jnp.dtype(self.config.compute_dtype)
+            if self.config.compute_dtype
+            else None
+        )
+
+        def forward(params, model_state, x, key):
+            if compute_dtype is not None:
+                # bf16 compute, f32 master weights: cast at the boundary;
+                # gradients flow back through the cast and land in f32.
+                params = jax.tree.map(
+                    lambda p: p.astype(compute_dtype)
+                    if jnp.issubdtype(p.dtype, jnp.floating)
+                    else p,
+                    params,
+                )
+                x = x.astype(compute_dtype)
             scores, new_state = model.apply(
                 params, model_state, x, train=True, key=key
             )
+            return scores.astype(jnp.float32), new_state
+
+        if self.config.remat:
+            forward = jax.checkpoint(forward)
+
+        def loss_fn(params, model_state, batch, key):
+            x, y = batch
+            scores, new_state = forward(params, model_state, x, key)
             return self._loss(scores, y), (new_state, {})
 
         self.step = parallel.make_stateful_train_step(
