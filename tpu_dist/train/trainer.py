@@ -123,7 +123,55 @@ class Trainer:
             lambda params, state, x: model.apply(params, state, x, train=False)[0]
         )
 
-    def fit(self, dataset, *, epochs: int | None = None) -> list[EpochStats]:
+    def save(self, path, *, epoch: int = 0) -> None:
+        """Checkpoint the full training state (params, model state,
+        optimizer) — single writer, replicas identical (SURVEY.md §5)."""
+        from tpu_dist.train import checkpoint
+
+        checkpoint.save(
+            path,
+            {
+                "params": self.params,
+                "model_state": self.model_state,
+                "opt_state": self.opt_state,
+            },
+            step=epoch,
+        )
+
+    def restore(self, path) -> int:
+        """Restore state saved by `save`; returns the stored epoch index
+        (resume point)."""
+        from tpu_dist.train import checkpoint
+
+        like = {
+            "params": self.params,
+            "model_state": self.model_state,
+            "opt_state": self.opt_state,
+        }
+        state, epoch = checkpoint.restore(path, like)
+        self.params = parallel.replicate(state["params"], self.mesh)
+        self.model_state = parallel.replicate(state["model_state"], self.mesh)
+        self.opt_state = parallel.replicate(state["opt_state"], self.mesh)
+        return epoch
+
+    def fit(
+        self,
+        dataset,
+        *,
+        epochs: int | None = None,
+        start_epoch: int = 0,
+        checkpoint_dir: str | None = None,
+        trace_dir: str | None = None,
+    ) -> list[EpochStats]:
+        """Run the training loop.
+
+        ``start_epoch`` resumes mid-schedule (pair with `restore`);
+        ``checkpoint_dir`` writes ``ckpt_<epoch>.npz`` after each epoch;
+        ``trace_dir`` captures a jax.profiler trace of epoch
+        ``start_epoch`` (perfetto-viewable — SURVEY.md §5 tracing).
+        """
+        from tpu_dist.train import metrics as metrics_mod
+
         cfg = self.config
         loader = DistributedLoader(
             dataset, self.world, cfg.global_batch, seed=cfg.seed
@@ -137,21 +185,24 @@ class Trainer:
             )
         history = []
         step_key = jax.random.key(cfg.seed + 1)
-        for epoch in range(epochs if epochs is not None else cfg.epochs):
+        for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
             t0 = time.perf_counter()
             total_loss, num_batches = 0.0, 0
-            for bi, (x, y) in enumerate(loader.epoch(epoch)):
-                batch = parallel.shard_batch((x, y), self.mesh)
-                key = jax.random.fold_in(step_key, epoch * 100000 + bi)
-                (
-                    self.params,
-                    self.model_state,
-                    self.opt_state,
-                    loss,
-                    _,
-                ) = self.step(self.params, self.model_state, self.opt_state, batch, key)
-                total_loss += float(loss)
-                num_batches += 1
+            with metrics_mod.trace(trace_dir if epoch == start_epoch else None):
+                for bi, (x, y) in enumerate(loader.epoch(epoch)):
+                    batch = parallel.shard_batch((x, y), self.mesh)
+                    key = jax.random.fold_in(step_key, epoch * 100000 + bi)
+                    (
+                        self.params,
+                        self.model_state,
+                        self.opt_state,
+                        loss,
+                        _,
+                    ) = self.step(
+                        self.params, self.model_state, self.opt_state, batch, key
+                    )
+                    total_loss += float(loss)
+                    num_batches += 1
             dt = time.perf_counter() - t0
             mean_loss = total_loss / max(num_batches, 1)
             sps = num_batches * cfg.global_batch / dt
@@ -162,6 +213,10 @@ class Trainer:
                 f"{mean_loss:.4f}  [{sps:,.0f} samples/s]"
             )
             history.append(EpochStats(epoch, mean_loss, dt, sps))
+            if checkpoint_dir is not None:
+                self.save(
+                    f"{checkpoint_dir}/ckpt_{epoch}.npz", epoch=epoch + 1
+                )
         return history
 
     def evaluate(self, dataset, *, batch_size: int = 1000) -> float:
