@@ -50,6 +50,28 @@ class TestPallasMatmul:
                 jnp.ones((4, 4)), jnp.ones((4, 4)), epilogue="tanh", interpret=True
             )
 
+    @pytest.mark.parametrize("epilogue", ["none", "relu"])
+    def test_grad_matches_xla(self, epilogue):
+        """The kernel must be differentiable (custom VJP) — training goes
+        through it when the Dense flag is on."""
+        x = jax.random.normal(jax.random.key(0), (32, 64))
+        w = jax.random.normal(jax.random.key(1), (64, 16))
+        b = jax.random.normal(jax.random.key(2), (16,))
+        act = _EPILOGUES = {"none": lambda v: v, "relu": jax.nn.relu}[epilogue]
+
+        def loss_kernel(x, w, b):
+            return ops.matmul(x, w, b, epilogue=epilogue, interpret=True).sum()
+
+        def loss_ref(x, w, b):
+            return act(x @ w + b).sum()
+
+        gk = jax.grad(loss_kernel, argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(x, w, b)
+        for a, b_ in zip(gk, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-5, atol=2e-5
+            )
+
     def test_dense_pallas_flag(self, monkeypatch):
         """Dense routes through the kernel when the flag is set; results
         match the default path."""

@@ -88,18 +88,24 @@ def launch(
     results: list[Any] = [None] * world
     error = None
     for rank, (p, conn) in enumerate(zip(procs, conns)):
+        # Fail-stop: once any child has reported an error, the survivors
+        # are likely blocked in a collective/barrier waiting for it — give
+        # them only a short grace period instead of the full timeout.
+        wait = 5.0 if error is not None else timeout
         try:
-            if conn.poll(timeout):
+            if conn.poll(wait):
                 status, payload = conn.recv()
                 if status == "ok":
                     results[rank] = pickle.loads(payload)
                 else:
                     error = error or payload
             else:
-                error = error or f"rank {rank}: no result within {timeout}s"
+                error = error or f"rank {rank}: no result within {wait}s"
         except EOFError:
             error = error or f"rank {rank}: died without reporting a result"
     for p in procs:
+        if error is not None and p.is_alive():
+            p.terminate()
         p.join(timeout=10)
         if p.is_alive():
             p.terminate()

@@ -73,11 +73,19 @@ def load_cifar10(split: str = "train", *, limit: int | None = None) -> Dataset:
         base = Path(d)
         paths = [base / f for f in files]
         if all(p.exists() for p in paths):
-            parts = [_parse_bin(p) for p in paths]
-            imgs = np.concatenate([p[0] for p in parts])
-            labels = np.concatenate([p[1] for p in parts])
-            if limit is not None:
-                imgs, labels = imgs[:limit], labels[:limit]
+            # Truncate in uint8, and stop parsing files once `limit`
+            # records are in hand — normalizing all 50k to float32 just to
+            # keep a slice would waste ~600 MB of work.
+            img_parts, label_parts, have = [], [], 0
+            for p in paths:
+                imgs, labels = _parse_bin(p)
+                img_parts.append(imgs)
+                label_parts.append(labels)
+                have += len(labels)
+                if limit is not None and have >= limit:
+                    break
+            imgs = np.concatenate(img_parts)[:limit]
+            labels = np.concatenate(label_parts)[:limit]
             return Dataset(_normalize(imgs), labels)
     n = limit if limit is not None else (50000 if split == "train" else 10000)
     return synthetic_cifar10(n, seed=0 if split == "train" else 1)

@@ -48,9 +48,12 @@ class Dense(Module):
         from tpu_dist.ops.matmul import use_pallas_dense
 
         if self.use_bias and x.ndim == 2 and use_pallas_dense():
+            import jax as _jax
+
             from tpu_dist.ops.matmul import matmul
 
-            return matmul(x, params["w"], params["b"]), state
+            interp = _jax.default_backend() != "tpu"
+            return matmul(x, params["w"], params["b"], interpret=interp), state
         y = x @ params["w"]
         if self.use_bias:
             y = y + params["b"]

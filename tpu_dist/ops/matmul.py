@@ -57,31 +57,9 @@ def _pick_block(dim: int, target: int) -> int:
     return dim
 
 
-@functools.partial(
-    jax.jit, static_argnames=("epilogue", "bm", "bn", "bk", "interpret")
-)
-def matmul(
-    x: jax.Array,
-    w: jax.Array,
-    b: jax.Array | None = None,
-    *,
-    epilogue: str = "none",
-    bm: int = 256,
-    bn: int = 256,
-    bk: int = 512,
-    interpret: bool = False,
-) -> jax.Array:
-    """``epilogue(x @ w + b)`` in one kernel.  x: (M, K), w: (K, N),
-    b: (N,) or None.  Block sizes fall back to the full dimension when it
-    doesn't divide evenly (tiny shapes just become a single block)."""
-    if epilogue not in _EPILOGUES:
-        raise ValueError(f"unknown epilogue {epilogue!r}; one of {list(_EPILOGUES)}")
+def _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret):
     m, k = x.shape
-    k2, n = w.shape
-    if k != k2:
-        raise ValueError(f"inner dims mismatch: {x.shape} @ {w.shape}")
-    if b is None:
-        b = jnp.zeros((n,), x.dtype)
+    _, n = w.shape
     bm_, bn_, bk_ = _pick_block(m, bm), _pick_block(n, bn), _pick_block(k, bk)
     nk = k // bk_
     grid = (m // bm_, n // bn_, nk)
@@ -104,6 +82,68 @@ def matmul(
         ),
         interpret=interpret,
     )(x, w, b)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _matmul_core(x, w, b, epilogue, bm, bn, bk, interpret):
+    return _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret)
+
+
+def _matmul_fwd(x, w, b, epilogue, bm, bn, bk, interpret):
+    out = _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret)
+    return out, (x, w, b)
+
+
+def _matmul_bwd(epilogue, bm, bn, bk, interpret, res, g):
+    # Backward = two plain matmuls + a reduction; XLA owns those (they
+    # have no fusable epilogue).  The kernel's value-add — the fused
+    # forward epilogue — needs the pre-activation recomputed here for
+    # non-trivial epilogues (cheaper than saving an (M, N) residual).
+    x, w, b = res
+    if epilogue == "none":
+        d_pre = g
+    else:
+        pre = _matmul_impl(x, w, b, "none", bm, bn, bk, interpret)
+        _, act_vjp = jax.vjp(_EPILOGUES[epilogue], pre)
+        (d_pre,) = act_vjp(g)
+    dx = d_pre @ w.T
+    dw = x.T @ d_pre
+    db = d_pre.sum(0)
+    return dx.astype(x.dtype), dw.astype(w.dtype), db.astype(b.dtype)
+
+
+_matmul_core.defvjp(_matmul_fwd, _matmul_bwd)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("epilogue", "bm", "bn", "bk", "interpret")
+)
+def matmul(
+    x: jax.Array,
+    w: jax.Array,
+    b: jax.Array | None = None,
+    *,
+    epilogue: str = "none",
+    bm: int = 256,
+    bn: int = 256,
+    bk: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``epilogue(x @ w + b)`` in one kernel.  x: (M, K), w: (K, N),
+    b: (N,) or None.  Block sizes fall back to the full dimension when it
+    doesn't divide evenly (tiny shapes just become a single block).
+    Differentiable: a custom VJP computes dx/dw/db with plain XLA matmuls
+    (recomputing the pre-activation for fused epilogues), so the kernel is
+    safe inside `jax.grad`/train steps."""
+    if epilogue not in _EPILOGUES:
+        raise ValueError(f"unknown epilogue {epilogue!r}; one of {list(_EPILOGUES)}")
+    m, k = x.shape
+    k2, n = w.shape
+    if k != k2:
+        raise ValueError(f"inner dims mismatch: {x.shape} @ {w.shape}")
+    if b is None:
+        b = jnp.zeros((n,), x.dtype)
+    return _matmul_core(x, w, b, epilogue, bm, bn, bk, interpret)
 
 
 def use_pallas_dense() -> bool:

@@ -39,13 +39,7 @@ def _ring_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem, *, axis_name):
     my_id = lax.axis_index(axis_name)
     right = lax.rem(my_id + 1, n)
     left = lax.rem(my_id - 1 + n, n)
-
-    # Neighbor barrier: both neighbors must have entered the kernel (and
-    # thus allocated comm_buf) before any RDMA lands in it.
     barrier = pltpu.get_barrier_semaphore()
-    pltpu.semaphore_signal(barrier, inc=1, device_id=(left,))
-    pltpu.semaphore_signal(barrier, inc=1, device_id=(right,))
-    pltpu.semaphore_wait(barrier, 2)
 
     o_ref[:] = x_ref[:]
     comm_buf[0] = x_ref[:]
@@ -53,6 +47,17 @@ def _ring_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem, *, axis_name):
     def step_body(step, _):
         send_slot = lax.rem(step, 2)
         recv_slot = 1 - send_slot
+        # Backpressure: at step s we write the RIGHT neighbor's slot
+        # (1 - s%2), the very slot it sends from at step s-1.  A
+        # neighborhood barrier at the top of every step guarantees both
+        # neighbors have finished their previous step's send+recv+
+        # accumulate (and, at step 0, have entered the kernel and
+        # allocated comm_buf) before any RDMA lands in their buffers —
+        # without it a fast sender could overwrite a slot still being
+        # sent from, silently corrupting the sum for n >= 3.
+        pltpu.semaphore_signal(barrier, inc=1, device_id=(left,))
+        pltpu.semaphore_signal(barrier, inc=1, device_id=(right,))
+        pltpu.semaphore_wait(barrier, 2)
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[send_slot],
             dst_ref=comm_buf.at[recv_slot],

@@ -61,9 +61,11 @@ def _load():
 
 
 def free_port() -> int:
+    """A loopback TCP port where BOTH ``port`` and ``port + 1`` are free —
+    the bootstrap uses the pair (rendezvous / JAX coordinator)."""
     port = _load().td_free_port()
     if port == 0:
-        raise OSError("could not find a free port")
+        raise OSError("could not find a free port pair")
     return port
 
 

@@ -113,7 +113,24 @@ int connect_to(const char* addr, int port, int timeout_ms) {
 
 extern "C" {
 
+static bool port_bindable(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in sa{};
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  sa.sin_port = htons(static_cast<uint16_t>(port));
+  bool ok = bind(fd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) == 0;
+  close(fd);
+  return ok;
+}
+
 // Returns a free TCP port on the loopback interface (0 on failure).
+// The bootstrap contract uses TWO consecutive ports (MASTER_PORT for the
+// native rendezvous, MASTER_PORT+1 for the JAX coordination service — see
+// tpu_dist.comm.init), so both must be free.
 int td_free_port() {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return 0;
@@ -129,7 +146,11 @@ int td_free_port() {
   getsockname(fd, reinterpret_cast<sockaddr*>(&sa), &len);
   int port = ntohs(sa.sin_port);
   close(fd);
-  return port;
+  for (int attempt = 0; attempt < 64; ++attempt, ++port) {
+    if (port + 1 < 65536 && port_bindable(port) && port_bindable(port + 1))
+      return port;
+  }
+  return 0;
 }
 
 const char* td_last_error() { return g_err; }
@@ -172,7 +193,12 @@ static int run_master(const char* addr, int port, int world,
   set_timeout(lfd, timeout_ms);
 
   std::vector<std::string> payloads(static_cast<size_t>(world));
+  // Occupancy is tracked separately from the payload text: payloads may
+  // legitimately be empty strings, so emptiness must not double as the
+  // "slot free" sentinel (duplicate-rank requests have to collide).
+  std::vector<bool> occupied(static_cast<size_t>(world), false);
   payloads[0] = payload;
+  occupied[0] = true;
   std::vector<int> fds;
   std::vector<int> ranks;
   int next_rank = 1;
@@ -201,9 +227,9 @@ static int run_master(const char* addr, int port, int world,
     std::string wpayload = sp == std::string::npos ? "" : hello.substr(sp + 1);
     req = atoi(hello.c_str());
     int r = req >= 0 ? req : next_rank++;
-    while (req < 0 && r < world && !payloads[static_cast<size_t>(r)].empty())
+    while (req < 0 && r < world && occupied[static_cast<size_t>(r)])
       r = next_rank++;
-    if (r <= 0 || r >= world || !payloads[static_cast<size_t>(r)].empty()) {
+    if (r <= 0 || r >= world || occupied[static_cast<size_t>(r)]) {
       set_errmsg("rank collision or out of range during rendezvous");
       close(cfd);
       for (int fd : fds) close(fd);
@@ -211,6 +237,7 @@ static int run_master(const char* addr, int port, int world,
       return -1;
     }
     payloads[static_cast<size_t>(r)] = wpayload;
+    occupied[static_cast<size_t>(r)] = true;
     fds.push_back(cfd);
     ranks.push_back(r);
   }

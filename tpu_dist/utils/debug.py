@@ -62,11 +62,25 @@ def blocked_until_ready(tree, *, timeout_s: float = 120.0, what: str = "step"):
         return jax.block_until_ready(tree)
 
 
+def _buffer_keys(leaf: jax.Array) -> list:
+    """Device-buffer identities for a (possibly sharded) array.  Falls
+    back to the Python object id when the runtime doesn't expose buffer
+    pointers (e.g. tracers)."""
+    try:
+        return [
+            s.data.unsafe_buffer_pointer() for s in leaf.addressable_shards
+        ]
+    except Exception:
+        return [id(leaf)]
+
+
 def assert_no_aliasing(*trees) -> None:
-    """Raise if any two leaves across the given pytrees share a buffer —
-    catches accidental reuse of donated arrays (the donation/aliasing
-    check SURVEY.md §5 prescribes)."""
-    seen: dict[int, str] = {}
+    """Raise if any two leaves across the given pytrees share a device
+    buffer — catches accidental reuse of donated arrays (the
+    donation/aliasing check SURVEY.md §5 prescribes).  Identity is the
+    underlying buffer pointer per shard, not the Python wrapper, so
+    distinct `jax.Array` objects over one buffer are caught."""
+    seen: dict[object, str] = {}
     for ti, tree in enumerate(trees):
         for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
             if not isinstance(leaf, jax.Array):
@@ -77,11 +91,11 @@ def assert_no_aliasing(*trees) -> None:
                     f"deleted (donated) buffer — it was consumed by a "
                     f"donating jit call and must not be reused"
                 )
-            key = id(leaf)
             where = f"tree {ti} leaf {jax.tree_util.keystr(path)}"
-            if key in seen:
-                raise ValueError(
-                    f"aliased arrays: {where} and {seen[key]} are the same "
-                    f"buffer; donation would invalidate both"
-                )
-            seen[key] = where
+            for key in _buffer_keys(leaf):
+                if key in seen and seen[key] != where:
+                    raise ValueError(
+                        f"aliased arrays: {where} and {seen[key]} share a "
+                        f"device buffer; donation would invalidate both"
+                    )
+                seen[key] = where
