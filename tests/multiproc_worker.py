@@ -35,6 +35,55 @@ def psum_worker(rank, world):
     return float(np.asarray(out.addressable_shards[0].data)[0])
 
 
+def train_worker(rank, world):
+    """True multi-process data-parallel training: a global mesh spanning
+    both processes' devices, deterministic identical host batches, the
+    fused DP step with its gradient pmean crossing the process boundary.
+    Returns the per-step loss trajectory (must be identical on all
+    processes — the reference's cross-rank identity invariant)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpu_dist import data, models, nn, parallel, train
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs), ("data",))
+    n_dev = len(devs)
+
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(1234), models.IN_SHAPE)
+    opt = train.sgd(0.01, momentum=0.5)
+
+    def loss_fn(p, s, batch, key):
+        x, y = batch
+        scores, s2 = model.apply(p, s, x, train=True, key=key)
+        return nn.nll_loss(scores, y), (s2, {})
+
+    step = parallel.make_stateful_train_step(loss_fn, opt, mesh, donate=False)
+
+    def put(host, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx]
+        )
+
+    import numpy as _np
+
+    p = jax.tree.map(lambda a: put(_np.asarray(a), P()), params)
+    ms = jax.tree.map(lambda a: put(_np.asarray(a), P()), state)
+    os_ = jax.tree.map(lambda a: put(_np.asarray(a), P()), opt.init(params))
+
+    ds = data.load_mnist("train", synthetic_size=n_dev * 16 * 4)
+    loader = data.DistributedLoader(ds, n_dev, n_dev * 16)
+    losses = []
+    for bi, (x, y) in enumerate(loader.epoch(0)):
+        batch = (put(x, P("data")), put(y, P("data")))
+        p, ms, os_, loss, _ = step(p, ms, os_, batch, jax.random.key(bi))
+        losses.append(round(float(loss), 6))
+    return losses
+
+
 def main():
     from tpu_dist.comm.launch import launch
 
@@ -44,6 +93,11 @@ def main():
     expect = [6.0] * world
     assert res == expect, f"{res} != {expect}"
     print("MULTIPROCESS OK", res)
+
+    res = launch(train_worker, world, platform="cpu", devices_per_proc=devices_per_proc)
+    assert res[0] == res[1], f"loss trajectories diverged: {res}"
+    assert res[0][-1] < res[0][0], f"loss did not decrease: {res[0]}"
+    print("MULTIPROCESS TRAIN OK", res[0][:2], "...", res[0][-1])
 
 
 if __name__ == "__main__":

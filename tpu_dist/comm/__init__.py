@@ -9,18 +9,22 @@ from tpu_dist.comm.collectives import (
     ReduceOp,
     all_gather,
     all_reduce,
+    all_to_all,
     barrier,
     broadcast,
     gather,
     new_group,
     rank,
     reduce,
+    reduce_scatter,
+    ring_perm,
     scatter,
     send,
     sendrecv,
     shift,
     world_size,
 )
+from tpu_dist.comm.launch import launch
 from tpu_dist.comm.init import (
     InitConfig,
     init,
@@ -37,13 +41,17 @@ __all__ = [
     "ReduceOp",
     "all_gather",
     "all_reduce",
+    "all_to_all",
     "barrier",
     "broadcast",
     "devices",
     "gather",
     "init",
+    "launch",
     "make_mesh",
     "new_group",
+    "reduce_scatter",
+    "ring_perm",
     "process_count",
     "process_rank",
     "rank",

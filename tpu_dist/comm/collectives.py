@@ -233,6 +233,57 @@ def scatter(
 # ---------------------------------------------------------------------------
 
 
+def reduce_scatter(
+    x: jax.Array,
+    op: ReduceOp = ReduceOp.SUM,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    scatter_axis: int = 0,
+    tiled: bool = True,
+) -> jax.Array:
+    """Reduce across ranks, scatter the result: rank r gets chunk r of the
+    reduction along ``scatter_axis``.  The building block of the
+    bandwidth-optimal allreduce (tuto.md:354 exercise); SUM lowers to XLA
+    ReduceScatter via ``lax.psum_scatter``."""
+    if op is ReduceOp.SUM:
+        return lax.psum_scatter(
+            x, axis_name, scatter_dimension=scatter_axis, tiled=tiled
+        )
+    reduced = all_reduce(x, op, axis_name)
+    n = lax.axis_size(axis_name)
+    if x.shape[scatter_axis] % n:
+        raise ValueError(
+            f"scatter axis {scatter_axis} size {x.shape[scatter_axis]} not "
+            f"divisible by world size {n}"
+        )
+    piece = x.shape[scatter_axis] // n
+    return lax.dynamic_slice_in_dim(
+        reduced, lax.axis_index(axis_name) * piece, piece, scatter_axis
+    )
+
+
+def all_to_all(
+    x: jax.Array,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    split_axis: int,
+    concat_axis: int,
+) -> jax.Array:
+    """All-to-all: split ``x`` into n chunks along ``split_axis``, send
+    chunk i to rank i, concatenate what arrives along ``concat_axis``.
+    The resharding primitive behind Ulysses-style sequence parallelism
+    (`tpu_dist.parallel.ulysses_attention`)."""
+    n = lax.axis_size(axis_name)
+    if x.shape[split_axis] % n:
+        raise ValueError(
+            f"split axis {split_axis} size {x.shape[split_axis]} not "
+            f"divisible by world size {n}"
+        )
+    return lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
 def ring_perm(n: int) -> list[tuple[int, int]]:
     """The neighbor ring: every rank sends right, receives from left
     (allreduce.py:18-20).  Shared by `shift`, the ring allreduce, and ring

@@ -17,6 +17,7 @@ from tpu_dist.parallel.ring_attention import (
     RingMultiHeadAttention,
     ring_attention,
 )
+from tpu_dist.parallel.ulysses import ulysses_attention
 from tpu_dist.parallel.tensor_parallel import (
     MODEL_AXIS,
     column_parallel,
@@ -49,4 +50,5 @@ __all__ = [
     "ring_attention",
     "ring_reduce_scatter",
     "shard_batch",
+    "ulysses_attention",
 ]

@@ -1,0 +1,55 @@
+"""Ulysses-style sequence parallelism — the all-to-all alternative to ring
+attention.
+
+Where `ring_attention` keeps queries resident and rotates K/V blocks
+around the ppermute ring (communication ∝ steps, fully overlapped),
+Ulysses re-shards: an all-to-all converts sequence-sharded activations
+into head-sharded ones, every rank runs ordinary full-sequence attention
+over its subset of heads, and a second all-to-all restores sequence
+sharding.  Two collectives per attention call, no change to the attention
+math — the better trade when heads ≥ world size and ICI all-to-all
+bandwidth is plentiful; ring wins at extreme sequence lengths.  Both are
+first-class here (the reference has neither — SURVEY.md §2d records
+sequence parallelism as absent; the instructions make long-context a
+required capability).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from tpu_dist.nn.attention import dot_product_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Attention over sequence shards via head-resharding.
+
+    Args: local shards ``(batch, heads, s_local, head_dim)`` with the
+    sequence axis sharded over ``axis_name``; ``heads`` must be divisible
+    by the axis size.  Returns the local output shard, numerically equal
+    to full attention on the gathered sequence (tests assert this).
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % n:
+        raise ValueError(
+            f"heads {h} not divisible by sequence-parallel world {n} — "
+            f"use ring_attention for head counts below the world size"
+        )
+    # seq-sharded -> head-sharded: (b, h, s_local, d) -> (b, h/n, S, d)
+    reshard = lambda t: lax.all_to_all(  # noqa: E731
+        t, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+    o = dot_product_attention(
+        reshard(q), reshard(k), reshard(v), causal=causal
+    )
+    # head-sharded -> seq-sharded: (b, h/n, S, d) -> (b, h, s_local, d)
+    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1, tiled=True)
