@@ -23,6 +23,15 @@ allreduce:
 train:
 	cd demos && $(PY) train_dist.py $(DEMOFLAGS) --epochs 3 --samples 8192
 
+train-image:
+	cd demos && $(PY) train_image.py $(DEMOFLAGS) --model resnet18 --epochs 1 --samples 1024
+
+scaling:
+	$(PY) benchmarks/scaling.py --platform $(PLATFORM)
+
+multiproc:
+	$(PY) tests/multiproc_worker.py
+
 bench:
 	$(PY) bench.py
 

@@ -84,6 +84,18 @@ def train_worker(rank, world):
     return losses
 
 
+def failing_worker(rank, world):
+    """Failure-injection: rank 1 dies during init (before the barrier
+    completes for anyone) — the launcher must fail-stop quickly with the
+    real error, not hang for the full timeout (SURVEY.md §5 failure
+    model)."""
+    if rank == 1:
+        raise RuntimeError("injected failure in rank 1")
+    import jax
+
+    return jax.process_count()
+
+
 def main():
     from tpu_dist.comm.launch import launch
 
@@ -98,6 +110,19 @@ def main():
     assert res[0] == res[1], f"loss trajectories diverged: {res}"
     assert res[0][-1] < res[0][0], f"loss did not decrease: {res[0]}"
     print("MULTIPROCESS TRAIN OK", res[0][:2], "...", res[0][-1])
+
+    import time
+
+    t0 = time.perf_counter()
+    try:
+        launch(failing_worker, world, platform="cpu",
+               devices_per_proc=devices_per_proc, timeout=120.0)
+        raise AssertionError("launch should have raised")
+    except RuntimeError as e:
+        elapsed = time.perf_counter() - t0
+        assert "injected failure in rank 1" in str(e), e
+        assert elapsed < 60, f"fail-stop took {elapsed:.0f}s (should be fast)"
+    print(f"MULTIPROCESS FAILSTOP OK ({elapsed:.1f}s)")
 
 
 if __name__ == "__main__":

@@ -87,28 +87,41 @@ def launch(
         conns.append(parent_conn)
     results: list[Any] = [None] * world
     error = None
-    for rank, (p, conn) in enumerate(zip(procs, conns)):
-        # Fail-stop: once any child has reported an error, the survivors
-        # are likely blocked in a collective/barrier waiting for it — give
-        # them only a short grace period instead of the full timeout.
-        wait = 5.0 if error is not None else timeout
-        try:
-            if conn.poll(wait):
+    # Collect from ALL pipes concurrently: one dead rank leaves the others
+    # blocked in collectives/coordination barriers, so rank-by-rank
+    # polling would burn the full timeout before the real error surfaced.
+    # Fail-stop: after the first reported error, survivors get a short
+    # grace period, then are terminated.
+    import time as _time
+    from multiprocessing.connection import wait as mp_wait
+
+    pending = {conn: rank for rank, conn in enumerate(conns)}
+    deadline = _time.monotonic() + timeout
+    while pending:
+        limit = min(deadline, _time.monotonic() + 5.0) if error else deadline
+        wait_s = limit - _time.monotonic()
+        ready = mp_wait(list(pending), timeout=max(wait_s, 0)) if wait_s > 0 else []
+        if not ready:
+            break
+        for conn in ready:
+            rank = pending.pop(conn)  # type: ignore[arg-type]
+            try:
                 status, payload = conn.recv()
-                if status == "ok":
-                    results[rank] = pickle.loads(payload)
-                else:
-                    error = error or payload
+            except EOFError:
+                error = error or f"rank {rank}: died without reporting a result"
+                continue
+            if status == "ok":
+                results[rank] = pickle.loads(payload)
             else:
-                error = error or f"rank {rank}: no result within {wait}s"
-        except EOFError:
-            error = error or f"rank {rank}: died without reporting a result"
+                error = error or payload
+    for conn, rank in pending.items():
+        error = error or f"rank {rank}: no result before timeout/fail-stop"
     for p in procs:
-        if error is not None and p.is_alive():
+        if (error is not None or pending) and p.is_alive():
             p.terminate()
         p.join(timeout=10)
         if p.is_alive():
-            p.terminate()
+            p.kill()
     if error is not None:
         raise RuntimeError(f"launch failed — {error}")
     return results
