@@ -1,0 +1,109 @@
+"""Long-context attention benchmark: ring vs Ulysses vs full.
+
+Sweeps global sequence length on an N-way sequence-parallel mesh and
+times the three strategies (full attention runs unsharded as the
+reference point and memory ceiling — it materializes the (S, S) score
+matrix; the sharded paths never do).  Prints a table + one JSON line.
+
+Run: ``python benchmarks/attention.py [--platform cpu] [--world 8]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_one(fn, *args, iters=5):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=1)
+    ap.add_argument("--seqs", type=int, nargs="+", default=[1024, 4096, 8192])
+    ap.add_argument("--causal", action="store_true")
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.world}"
+        )
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_dist import comm, parallel
+    from tpu_dist.nn import dot_product_attention
+
+    mesh = comm.make_mesh(args.world, ("seq",), platform=args.platform)
+    shard = NamedSharding(mesh, P(None, None, "seq", None))
+    results = {}
+    for S in args.seqs:
+        if S % args.world:
+            print(f"skip S={S} (not divisible by world)", file=sys.stderr)
+            continue
+        shape = (args.batch, args.heads, S, args.dim)
+        q = jax.device_put(
+            jax.random.normal(jax.random.key(0), shape, jnp.bfloat16), shard
+        )
+
+        def sharded(fn_name):
+            fn = {
+                "ring": parallel.ring_attention,
+                "ulysses": parallel.ulysses_attention,
+            }[fn_name]
+            mapped = jax.jit(
+                jax.shard_map(
+                    lambda a, b, c: fn(a, b, c, "seq", causal=args.causal),
+                    mesh=mesh,
+                    in_specs=(P(None, None, "seq"),) * 3,
+                    out_specs=P(None, None, "seq"),
+                    check_vma=False,
+                )
+            )
+            return lambda: mapped(q, q, q)
+
+        full = jax.jit(lambda a: dot_product_attention(a, a, a, causal=args.causal))
+        row = {}
+        for name, thunk in [
+            ("full", lambda: full(q)),
+            ("ring", sharded("ring")),
+            ("ulysses", sharded("ulysses")),
+        ]:
+            try:
+                row[name] = bench_one(thunk) * 1e3
+            except Exception as e:  # OOM for full at long S is expected
+                row[name] = None
+                print(f"S={S} {name}: {type(e).__name__}", file=sys.stderr)
+        results[S] = row
+        cells = "  ".join(
+            f"{k}={v:8.2f}ms" if v else f"{k}=     OOM" for k, v in row.items()
+        )
+        print(f"S={S:6d}  {cells}", file=sys.stderr)
+    print(json.dumps({"metric": "attention_ms", "world": args.world,
+                      "causal": args.causal, "results": results}))
+
+
+if __name__ == "__main__":
+    main()
