@@ -65,6 +65,47 @@ def test_train_step_matches_single_device_global_batch():
     assert losses[-1] < losses[0], "loss must decrease"
 
 
+def test_auto_step_matches_explicit_step():
+    """GSPMD (jit + shardings) and shard_map (+ explicit pmean) styles
+    must produce identical training trajectories."""
+    mesh = comm.make_mesh(8, ("data",), platform="cpu")
+    opt = train.sgd(0.1, momentum=0.5)
+
+    def stateful_loss(params, state, batch, key):
+        loss, aux = _quadratic_loss(params, batch, key)
+        return loss, (state, aux)
+
+    explicit = parallel.make_stateful_train_step(
+        stateful_loss, opt, mesh, donate=False
+    )
+    auto = parallel.make_train_step_auto(
+        stateful_loss, opt, mesh, donate=False
+    )
+
+    key = jax.random.key(0)
+    x = jax.random.normal(key, (16, 3))
+    y = x @ jnp.array([[1.0], [-2.0], [0.5]])
+    params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    def run_steps(step):
+        p = parallel.replicate(params, mesh)
+        s = parallel.replicate((), mesh)
+        o = parallel.replicate(opt.init(params), mesh)
+        batch = parallel.shard_batch((x, y), mesh)
+        losses = []
+        for i in range(4):
+            p, s, o, loss, _ = step(p, s, o, batch, jax.random.key(1))
+            losses.append(float(loss))
+        return p, losses
+
+    p_e, l_e = run_steps(explicit)
+    p_a, l_a = run_steps(auto)
+    np.testing.assert_allclose(l_e, l_a, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(p_e["w"]), np.asarray(p_a["w"]), rtol=1e-6
+    )
+
+
 def test_torch_momentum_semantics():
     """buf = m*buf + g; p -= lr*buf (no dampening) — two steps by hand."""
     opt = train.sgd(0.5, momentum=0.5)

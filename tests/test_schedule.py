@@ -1,0 +1,68 @@
+"""LR schedule tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist import train
+from tpu_dist.train import schedule
+
+
+def test_constant():
+    f = schedule.constant(0.01)
+    assert float(f(0)) == pytest.approx(0.01)
+    assert float(f(10_000)) == pytest.approx(0.01)
+
+
+def test_cosine_warmup_and_decay():
+    f = schedule.cosine(1.0, total_steps=100, warmup_steps=10)
+    assert float(f(0)) == pytest.approx(0.0)
+    assert float(f(5)) == pytest.approx(0.5)
+    assert float(f(10)) == pytest.approx(1.0)
+    assert float(f(55)) == pytest.approx(0.5, abs=1e-6)  # halfway point
+    assert float(f(100)) == pytest.approx(0.0, abs=1e-6)
+    assert float(f(200)) == pytest.approx(0.0, abs=1e-6)  # clipped
+
+
+def test_cosine_validates():
+    with pytest.raises(ValueError, match="must exceed"):
+        schedule.cosine(1.0, total_steps=5, warmup_steps=10)
+
+
+def test_step_decay():
+    f = schedule.step_decay(1.0, gamma=0.1, every=10)
+    assert float(f(0)) == pytest.approx(1.0)
+    assert float(f(9)) == pytest.approx(1.0)
+    assert float(f(10)) == pytest.approx(0.1)
+    assert float(f(25)) == pytest.approx(0.01)
+
+
+def test_sgd_with_schedule_steps_lr():
+    """The scheduled lr must be applied per step (state carries a step
+    counter) — two steps under step_decay(every=1) use lr 1.0 then 0.1."""
+    opt = train.sgd(schedule.step_decay(1.0, gamma=0.1, every=1))
+    p = {"w": jnp.array([0.0])}
+    s = opt.init(p)
+    g = {"w": jnp.array([1.0])}
+    p, s = opt.update(p, g, s)  # lr=1.0 -> w=-1.0
+    np.testing.assert_allclose(np.asarray(p["w"]), [-1.0])
+    p, s = opt.update(p, g, s)  # lr=0.1 -> w=-1.1
+    np.testing.assert_allclose(np.asarray(p["w"]), [-1.1], rtol=1e-6)
+    assert int(s["step"]) == 2
+
+
+def test_sgd_schedule_with_momentum_jits():
+    opt = train.sgd(schedule.cosine(0.1, 100, warmup_steps=5), momentum=0.9)
+    p = {"w": jnp.ones(4)}
+    s = opt.init(p)
+
+    @jax.jit
+    def step(p, s):
+        g = {"w": jnp.ones(4)}
+        return opt.update(p, g, s)
+
+    for _ in range(3):
+        p, s = step(p, s)
+    assert int(s["step"]) == 3
+    assert np.isfinite(np.asarray(p["w"])).all()

@@ -10,6 +10,7 @@ from tpu_dist.parallel.data_parallel import (
     average_gradients,
     make_stateful_train_step,
     make_train_step,
+    make_train_step_auto,
     replicate,
     shard_batch,
 )
@@ -43,6 +44,7 @@ __all__ = [
     "tp_mlp",
     "make_stateful_train_step",
     "make_train_step",
+    "make_train_step_auto",
     "replicate",
     "ring_all_gather",
     "ring_all_reduce",

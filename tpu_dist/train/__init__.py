@@ -1,6 +1,6 @@
 """`tpu_dist.train` — optimizers, trainer, checkpointing, metrics."""
 
-from tpu_dist.train import checkpoint, metrics
+from tpu_dist.train import checkpoint, metrics, schedule
 from tpu_dist.train.optim import Optimizer, adamw, sgd
 from tpu_dist.train.trainer import EpochStats, TrainConfig, Trainer
 
@@ -12,5 +12,6 @@ __all__ = [
     "adamw",
     "checkpoint",
     "metrics",
+    "schedule",
     "sgd",
 ]

@@ -28,21 +28,42 @@ class Optimizer:
     update: Callable[[Any, Any, Any], tuple[Any, Any]]
 
 
-def sgd(lr: float, momentum: float = 0.0) -> Optimizer:
-    """torch-semantics SGD with momentum (train_dist.py:110)."""
+def sgd(lr, momentum: float = 0.0) -> Optimizer:
+    """torch-semantics SGD with momentum (train_dist.py:110).
+
+    ``lr`` may be a float (the reference's fixed 0.01) or a schedule
+    ``f(step) -> lr`` from `tpu_dist.train.schedule`; with a schedule the
+    state carries a step counter.
+    """
+    lr_fn = lr if callable(lr) else None
 
     def init(params):
-        if momentum == 0.0:
-            return ()
-        return jax.tree.map(jnp.zeros_like, params)
+        state = {}
+        if momentum != 0.0:
+            state["buf"] = jax.tree.map(jnp.zeros_like, params)
+        if lr_fn is not None:
+            state["step"] = jnp.zeros((), jnp.int32)
+        return state
 
     def update(params, grads, state):
+        new_state = dict(state)
+        if lr_fn is not None:
+            step = state["step"]
+            cur_lr = lr_fn(step)
+            new_state["step"] = step + 1
+        else:
+            cur_lr = lr
         if momentum == 0.0:
-            new_params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
-            return new_params, state
-        new_buf = jax.tree.map(lambda b, g: momentum * b + g, state, grads)
-        new_params = jax.tree.map(lambda p, b: p - lr * b, params, new_buf)
-        return new_params, new_buf
+            direction = grads
+        else:
+            direction = jax.tree.map(
+                lambda b, g: momentum * b + g, state["buf"], grads
+            )
+            new_state["buf"] = direction
+        new_params = jax.tree.map(
+            lambda p, d: p - cur_lr * d, params, direction
+        )
+        return new_params, new_state
 
     return Optimizer(init, update)
 

@@ -1,0 +1,44 @@
+"""Learning-rate schedules.
+
+Not in the reference (fixed lr=0.01, train_dist.py:110 — the default here
+remains a constant schedule so parity runs are untouched), but the
+extended configs (ViT especially) need warmup + decay.  A schedule is just
+``f(step) -> lr`` evaluated inside the compiled update, so it costs
+nothing at runtime.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def constant(lr: float):
+    return lambda step: jnp.float32(lr)
+
+
+def cosine(base_lr: float, total_steps: int, *, warmup_steps: int = 0):
+    """Linear warmup to ``base_lr`` then cosine decay to zero."""
+    if total_steps <= warmup_steps:
+        raise ValueError(
+            f"total_steps {total_steps} must exceed warmup_steps {warmup_steps}"
+        )
+
+    def f(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = base_lr * step / jnp.maximum(warmup_steps, 1)
+        progress = (step - warmup_steps) / (total_steps - warmup_steps)
+        progress = jnp.clip(progress, 0.0, 1.0)
+        decayed = base_lr * 0.5 * (1.0 + jnp.cos(jnp.pi * progress))
+        return jnp.where(step < warmup_steps, warm, decayed)
+
+    return f
+
+
+def step_decay(base_lr: float, *, gamma: float = 0.1, every: int = 30):
+    """Multiply by ``gamma`` every ``every`` steps (epoch-style decay)."""
+
+    def f(step):
+        k = jnp.floor(jnp.asarray(step, jnp.float32) / every)
+        return base_lr * gamma**k
+
+    return f
