@@ -32,6 +32,9 @@ scaling:
 multiproc:
 	$(PY) tests/multiproc_worker.py
 
+longcontext:
+	cd demos && $(PY) ring_attention.py $(DEMOFLAGS)
+
 bench:
 	$(PY) bench.py
 

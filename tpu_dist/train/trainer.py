@@ -219,14 +219,22 @@ class Trainer:
                 )
         return history
 
-    def evaluate(self, dataset, *, batch_size: int = 1000) -> float:
-        """Top-1 accuracy with dropout off.  Every sample is scored: the
-        trailing partial batch is zero-padded to the compiled batch shape
-        and the padding masked out of the count."""
+    def evaluate(self, dataset, *, batch_size: int = 1024) -> float:
+        """Top-1 accuracy with dropout off, data-parallel over the mesh.
+
+        Every sample is scored: the trailing partial batch is zero-padded
+        to the compiled batch shape and the padding masked out of the
+        count.  Batches are sharded over the mesh's leading axis, so eval
+        uses all chips like training does."""
         n = len(dataset)
         if n == 0:
             raise ValueError("cannot evaluate an empty dataset")
-        batch_size = min(batch_size, n)
+        # Round the batch to a multiple of the mesh size (sharding needs
+        # equal pieces), never below it.
+        batch_size = max(self.world, min(batch_size, n) // self.world * self.world)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharded = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
         correct = 0
         for i in range(0, n, batch_size):
             xs = dataset.images[i : i + batch_size]
@@ -235,7 +243,8 @@ class Trainer:
             if valid < batch_size:
                 pad = batch_size - valid
                 xs = np.concatenate([xs, np.zeros((pad,) + xs.shape[1:], xs.dtype)])
-            scores = self._eval_apply(self.params, self.model_state, jnp.asarray(xs))
+            xs = jax.device_put(jnp.asarray(xs), sharded)
+            scores = self._eval_apply(self.params, self.model_state, xs)
             pred = np.asarray(scores).argmax(-1)[:valid]
             correct += int((pred == ys).sum())
         return correct / n
