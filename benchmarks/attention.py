@@ -98,7 +98,8 @@ def main():
                 print(f"S={S} {name}: {type(e).__name__}", file=sys.stderr)
         results[S] = row
         cells = "  ".join(
-            f"{k}={v:8.2f}ms" if v else f"{k}=     OOM" for k, v in row.items()
+            f"{k}={v:8.2f}ms" if v is not None else f"{k}=     OOM"
+            for k, v in row.items()
         )
         print(f"S={S:6d}  {cells}", file=sys.stderr)
     print(json.dumps({"metric": "attention_ms", "world": args.world,

@@ -239,15 +239,15 @@ def reduce_scatter(
     axis_name: str = DEFAULT_AXIS,
     *,
     scatter_axis: int = 0,
-    tiled: bool = True,
 ) -> jax.Array:
-    """Reduce across ranks, scatter the result: rank r gets chunk r of the
-    reduction along ``scatter_axis``.  The building block of the
+    """Reduce across ranks, scatter the result: rank r gets chunk r
+    (size ``dim / n``) of the reduction along ``scatter_axis`` — always
+    tiled semantics, identical across ops.  The building block of the
     bandwidth-optimal allreduce (tuto.md:354 exercise); SUM lowers to XLA
     ReduceScatter via ``lax.psum_scatter``."""
     if op is ReduceOp.SUM:
         return lax.psum_scatter(
-            x, axis_name, scatter_dimension=scatter_axis, tiled=tiled
+            x, axis_name, scatter_dimension=scatter_axis, tiled=True
         )
     reduced = all_reduce(x, op, axis_name)
     n = lax.axis_size(axis_name)

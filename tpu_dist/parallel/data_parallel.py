@@ -33,13 +33,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 
 
-def average_gradients(grads: Any, axis_name: str = DATA_AXIS) -> Any:
+def average_gradients(
+    grads: Any, axis_name: str = DATA_AXIS, *, backend: str = "psum"
+) -> Any:
     """``average_gradients(model)`` (train_dist.py:94-100) over a pytree:
     sum across data-parallel ranks, divide by world size — i.e. ``pmean``.
     One fused collective over the whole tree instead of one blocking
     all_reduce per parameter (and without the reference's type-guard bug,
-    SURVEY.md §2c.2)."""
-    return lax.pmean(grads, axis_name)
+    SURVEY.md §2c.2).
+
+    ``backend='ring'`` swaps in the hand-rolled chunked ppermute ring
+    (`tpu_dist.parallel.ring_all_reduce_chunked`) — the reference's
+    allreduce.py path used for its real purpose.  Numerically equivalent
+    (tests assert identical training); ``'psum'`` (XLA AllReduce) is the
+    production default.
+    """
+    if backend == "psum":
+        return lax.pmean(grads, axis_name)
+    if backend == "ring":
+        from tpu_dist.parallel.ring import ring_all_reduce_chunked
+
+        n = lax.axis_size(axis_name)
+        return jax.tree.map(
+            lambda g: ring_all_reduce_chunked(g, axis_name) / n, grads
+        )
+    raise ValueError(f"unknown grad-reduce backend {backend!r}")
 
 
 def make_train_step(
@@ -105,6 +123,7 @@ def make_stateful_train_step(
     *,
     axis_name: str = DATA_AXIS,
     donate: bool = True,
+    grad_reduce: str = "psum",
 ):
     """Like `make_train_step` but threads non-differentiated model state
     (e.g. batch-norm running statistics) through the step.
@@ -122,7 +141,7 @@ def make_stateful_train_step(
         (loss, (new_state, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params, model_state, batch, key)
-        grads = average_gradients(grads, axis_name)
+        grads = average_gradients(grads, axis_name, backend=grad_reduce)
         loss = lax.pmean(loss, axis_name)
         new_state = _pmean_float_leaves(new_state, axis_name)
         aux = _pmean_float_leaves(aux, axis_name)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import jax
 from jax import lax
 
+from tpu_dist.comm.collectives import all_to_all
 from tpu_dist.nn.attention import dot_product_attention
 
 
@@ -45,11 +46,11 @@ def ulysses_attention(
             f"use ring_attention for head counts below the world size"
         )
     # seq-sharded -> head-sharded: (b, h, s_local, d) -> (b, h/n, S, d)
-    reshard = lambda t: lax.all_to_all(  # noqa: E731
-        t, axis_name, split_axis=1, concat_axis=2, tiled=True
+    reshard = lambda t: all_to_all(  # noqa: E731
+        t, axis_name, split_axis=1, concat_axis=2
     )
     o = dot_product_attention(
         reshard(q), reshard(k), reshard(v), causal=causal
     )
     # head-sharded -> seq-sharded: (b, h/n, S, d) -> (b, h, s_local, d)
-    return lax.all_to_all(o, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    return all_to_all(o, axis_name, split_axis=2, concat_axis=1)

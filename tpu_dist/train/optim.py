@@ -34,6 +34,10 @@ def sgd(lr, momentum: float = 0.0) -> Optimizer:
     ``lr`` may be a float (the reference's fixed 0.01) or a schedule
     ``f(step) -> lr`` from `tpu_dist.train.schedule`; with a schedule the
     state carries a step counter.
+
+    State format: ``{"buf": <momentum pytree>?, "step": <int32>?}`` (keys
+    present only when used).  Checkpoints embed this structure; it is
+    part of the checkpoint compatibility surface.
     """
     lr_fn = lr if callable(lr) else None
 
