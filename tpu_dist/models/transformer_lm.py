@@ -1,0 +1,129 @@
+"""Decoder-only transformer LM — the long-context flagship.
+
+No reference analog (the 2017 tutorial has no sequence models,
+SURVEY.md §2d) — this family exists because long-context/sequence
+parallelism is first-class in this framework: the same parameter pytree
+runs either dense (`TransformerLM.apply`) or sequence-parallel
+(`TransformerLM.apply_seq_parallel` inside shard_map, attention cores
+swapped for `tpu_dist.parallel.ring_attention`), and tests assert the two
+agree numerically.  Token embedding, learned positions, pre-norm blocks,
+weight-tied output head.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist import nn
+from tpu_dist.nn.core import Module
+from tpu_dist.models.vit import EncoderBlock
+
+
+class TransformerLM(Module):
+    def __init__(
+        self,
+        *,
+        vocab: int = 256,
+        dim: int = 128,
+        depth: int = 4,
+        heads: int = 4,
+        max_seq: int = 1024,
+    ):
+        self.vocab = vocab
+        self.dim = dim
+        self.heads = heads
+        self.max_seq = max_seq
+        self.embed = nn.Embedding(vocab, dim)
+        self.blocks = [
+            EncoderBlock(dim, heads, causal=True) for _ in range(depth)
+        ]
+        self.ln = nn.LayerNorm()
+
+    def init(self, key, input_shape=None):
+        del input_shape
+        ks = jax.random.split(key, len(self.blocks) + 3)
+        tok_shape = (self.max_seq, self.dim)
+        params = {
+            "embed": self.embed.init(ks[0], ())[0],
+            "pos": jax.random.normal(ks[1], (1, self.max_seq, self.dim)) * 0.02,
+            "blocks": [
+                blk.init(k, tok_shape)[0] for blk, k in zip(self.blocks, ks[2:])
+            ],
+            "ln": self.ln.init(ks[-1], tok_shape)[0],
+        }
+        return params, {}
+
+    def _trunk(self, params, tokens, *, pos_offset=0):
+        b, s = tokens.shape
+        h = params["embed"]["table"][tokens]
+        h = h + jax.lax.dynamic_slice_in_dim(
+            params["pos"], pos_offset, s, axis=1
+        )
+        return h
+
+    def apply(self, params, state, tokens, *, train=False, key=None):
+        """Dense forward: (batch, seq) int tokens -> (batch, seq, vocab)
+        logits (weight-tied head)."""
+        h = self._trunk(params, tokens)
+        for blk, pb in zip(self.blocks, params["blocks"]):
+            h, _ = blk.apply(pb, {}, h, train=train)
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        logits = h @ params["embed"]["table"].T
+        return logits, state
+
+    def apply_seq_parallel(self, params, tokens_local, axis_name):
+        """Sequence-parallel forward for use INSIDE shard_map: tokens are
+        the local sequence shard; attention runs as a ppermute ring over
+        ``axis_name``; everything else is token-local.  Same params as
+        `apply` — tests assert bitwise-tolerance agreement."""
+        from jax import lax
+
+        from tpu_dist.parallel.ring_attention import ring_attention
+
+        b, s_local = tokens_local.shape
+        r = lax.axis_index(axis_name)
+        h = self._trunk(params, tokens_local, pos_offset=r * s_local)
+        for blk, pb in zip(self.blocks, params["blocks"]):
+            # pre-norm attention with the ring core
+            x1, _ = blk.ln1.apply(pb["ln1"], {}, h)
+            attn = blk.attn
+            qkv, _ = attn._qkv.apply(pb["attn"]["qkv"], {}, x1)
+            qkv = qkv.reshape(b, s_local, 3, attn.heads, attn.head_dim)
+            q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+            o = ring_attention(q, k, v, axis_name, causal=True)
+            o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, attn.dim)
+            o, _ = attn._out.apply(pb["attn"]["out"], {}, o)
+            h = h + o
+            x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
+            m, _ = blk.mlp.apply(pb["mlp"], {}, x2)
+            h = h + m
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        return h @ params["embed"]["table"].T
+
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross-entropy: predict tokens[:, 1:] from positions
+    [:, :-1]."""
+    return nn.cross_entropy(
+        logits[:, :-1].reshape(-1, logits.shape[-1]),
+        tokens[:, 1:].reshape(-1),
+    )
+
+
+def synthetic_tokens(
+    n: int, seq: int, vocab: int = 256, *, seed: int = 0
+) -> jax.Array:
+    """Deterministic learnable token streams: a fixed random Markov chain
+    (every next-token distribution is a delta on a seeded permutation), so
+    a model that learns the transition table drives loss toward zero."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    table = rng.permutation(vocab)
+    starts = rng.integers(0, vocab, size=n)
+    out = np.empty((n, seq), np.int32)
+    out[:, 0] = starts
+    for t in range(1, seq):
+        out[:, t] = table[out[:, t - 1]]
+    return jnp.asarray(out)

@@ -38,9 +38,9 @@ class MLP(Module):
 class EncoderBlock(Module):
     """Pre-norm transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
 
-    def __init__(self, dim: int, heads: int, mlp_ratio: int = 4):
+    def __init__(self, dim: int, heads: int, mlp_ratio: int = 4, *, causal: bool = False):
         self.ln1 = nn.LayerNorm()
-        self.attn = nn.MultiHeadAttention(dim, heads)
+        self.attn = nn.MultiHeadAttention(dim, heads, causal=causal)
         self.ln2 = nn.LayerNorm()
         self.mlp = MLP(dim, dim * mlp_ratio)
 
