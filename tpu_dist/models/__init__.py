@@ -7,7 +7,12 @@ BASELINE.json configs 4-5.
 
 from tpu_dist.models.mnist_net import IN_SHAPE, NUM_CLASSES, mnist_net
 from tpu_dist.models.resnet import BasicBlock, resnet18
-from tpu_dist.models.transformer_lm import TransformerLM, lm_loss, synthetic_tokens
+from tpu_dist.models.transformer_lm import (
+    TransformerLM,
+    lm_loss,
+    lm_loss_seq_parallel,
+    synthetic_tokens,
+)
 from tpu_dist.models.vit import ViT, vit_tiny
 
 __all__ = [
@@ -17,6 +22,7 @@ __all__ = [
     "TransformerLM",
     "ViT",
     "lm_loss",
+    "lm_loss_seq_parallel",
     "mnist_net",
     "resnet18",
     "synthetic_tokens",

@@ -111,6 +111,49 @@ def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     )
 
 
+def lm_loss_seq_parallel(
+    logits_local: jax.Array, tokens_local: jax.Array, axis_name: str
+) -> jax.Array:
+    """Next-token loss over sequence shards, boundary-correct.
+
+    Position ``t``'s target is token ``t+1`` — for the LAST position of
+    each shard that token lives on the RIGHT neighbor, so targets are
+    built by shifting in each right neighbor's first token via
+    ``ppermute`` (one tiny collective).  The final global position has no
+    target and is masked.  Averaged so that the mean over ranks equals
+    the dense `lm_loss` on the gathered sequence (tests assert this),
+    which makes it directly usable under a data-axis ``pmean``.
+    """
+    from jax import lax
+
+    from tpu_dist.comm.collectives import ring_perm
+
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    b, s_local, vocab = logits_local.shape
+    # left neighbor -> me: I receive my RIGHT... ppermute ring sends
+    # i -> i+1; to receive the right neighbor's first token, send each
+    # shard's first token LEFT: perm (i -> i-1).
+    first = tokens_local[:, :1]
+    from_right = lax.ppermute(
+        first, axis_name, [(i, (i - 1) % n) for i in range(n)]
+    )
+    targets = jnp.concatenate([tokens_local[:, 1:], from_right], axis=1)
+    logp = jax.nn.log_softmax(logits_local, axis=-1)
+    picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # mask the last global position (rank n-1's last token has no target)
+    pos_valid = jnp.where(
+        (r == n - 1)
+        & (jnp.arange(s_local) == s_local - 1)[None, :].astype(bool),
+        0.0,
+        1.0,
+    )
+    # normalize so the pmean over ranks equals the dense mean over the
+    # (S_global - 1) predicted positions
+    total_positions = n * s_local - 1
+    return -(picked * pos_valid).sum() / (b * total_positions / n)
+
+
 def synthetic_tokens(
     n: int, seq: int, vocab: int = 256, *, seed: int = 0
 ) -> jax.Array:
