@@ -79,6 +79,27 @@ def test_allreduce_driver_known_answer():
     np.testing.assert_allclose(out, np.full((4, 2, 2), 4.0**4))
 
 
+@pytest.mark.parametrize("dtype", ["bfloat16", "float32", "int32"])
+def test_ring_dtypes(dtype):
+    """Rings must handle the MXU-native bf16 and integer payloads."""
+
+    def fn():
+        x = (jnp.arange(12) + comm.rank() + 1).astype(dtype)
+        return (
+            parallel.ring_all_reduce(x),
+            parallel.ring_all_reduce_chunked(x),
+            comm.all_reduce(x),
+        )
+
+    naive, chunked, psum = run(fn, world=4)
+    np.testing.assert_allclose(
+        np.asarray(naive, np.float64), np.asarray(psum, np.float64), rtol=1e-2
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunked, np.float64), np.asarray(psum, np.float64), rtol=1e-2
+    )
+
+
 def test_world_size_one():
     def fn():
         x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
