@@ -18,6 +18,8 @@ line the driver records.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -26,6 +28,32 @@ import numpy as np
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def ensure_live_backend(timeout_s: float = 120.0) -> None:
+    """Probe the default JAX backend in a SUBPROCESS first: in this
+    container the TPU is reached through a tunnel that can hang
+    indefinitely at init, which would wedge the whole benchmark.  If the
+    probe can't produce devices in time, pin this process to CPU so the
+    bench always emits its JSON line (flagging the fallback on stderr)."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        if proc.returncode == 0:
+            log(f"backend probe: {proc.stdout.strip()}")
+            return
+        log(f"backend probe failed: {proc.stderr[-500:]}")
+    except subprocess.TimeoutExpired:
+        log(f"backend probe hung >{timeout_s:.0f}s (tunnel down?)")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    log("falling back to CPU — numbers are NOT TPU numbers")
 
 
 BATCH = 128
@@ -121,6 +149,7 @@ def bench_torch_reference() -> float:
 
 
 def main():
+    ensure_live_backend()
     value = bench_tpu_dist()
     try:
         baseline = bench_torch_reference()
