@@ -18,7 +18,6 @@ line the driver records.
 from __future__ import annotations
 
 import json
-import os
 import subprocess
 import sys
 import time

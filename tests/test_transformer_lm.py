@@ -49,6 +49,18 @@ def test_learns_markov_chain(lm, lm_params):
     assert float(l) < l0 * 0.7, (l0, float(l))
 
 
+def test_seq_parallel_overlength_raises(lm, lm_params):
+    """Global sequence beyond max_seq must fail loudly, not clamp the
+    positional table."""
+    tokens = models.synthetic_tokens(1, 16, 64)  # 4 ranks x 16 = 64 > 32
+
+    def fn(params, tokens):
+        return lm.apply_seq_parallel(params, tokens, comm.DEFAULT_AXIS)
+
+    with pytest.raises(ValueError, match="exceeds max_seq"):
+        run(fn, lm_params, tokens, world=4)
+
+
 def test_seq_parallel_loss_matches_dense(lm, lm_params):
     """pmean over ranks of the sharded boundary-correct loss == dense
     lm_loss on the gathered sequence."""

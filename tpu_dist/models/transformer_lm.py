@@ -79,21 +79,26 @@ class TransformerLM(Module):
         `apply` — tests assert bitwise-tolerance agreement."""
         from jax import lax
 
-        from tpu_dist.parallel.ring_attention import ring_attention
+        from tpu_dist.parallel.ring_attention import RingMultiHeadAttention
 
         b, s_local = tokens_local.shape
+        n = lax.axis_size(axis_name)
+        if n * s_local > self.max_seq:
+            raise ValueError(
+                f"global sequence {n} ranks x {s_local} tokens = "
+                f"{n * s_local} exceeds max_seq {self.max_seq} — the "
+                f"positional table would silently clamp"
+            )
         r = lax.axis_index(axis_name)
         h = self._trunk(params, tokens_local, pos_offset=r * s_local)
+        # Same block math as `apply`, with the attention core swapped for
+        # the ring module (identical param structure by construction).
+        ring_mha = RingMultiHeadAttention(
+            self.dim, self.heads, axis_name=axis_name, causal=True
+        )
         for blk, pb in zip(self.blocks, params["blocks"]):
-            # pre-norm attention with the ring core
             x1, _ = blk.ln1.apply(pb["ln1"], {}, h)
-            attn = blk.attn
-            qkv, _ = attn._qkv.apply(pb["attn"]["qkv"], {}, x1)
-            qkv = qkv.reshape(b, s_local, 3, attn.heads, attn.head_dim)
-            q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
-            o = ring_attention(q, k, v, axis_name, causal=True)
-            o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, attn.dim)
-            o, _ = attn._out.apply(pb["attn"]["out"], {}, o)
+            o, _ = ring_mha.apply(pb["attn"], {}, x1)
             h = h + o
             x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
             m, _ = blk.mlp.apply(pb["mlp"], {}, x2)
@@ -125,8 +130,6 @@ def lm_loss_seq_parallel(
     which makes it directly usable under a data-axis ``pmean``.
     """
     from jax import lax
-
-    from tpu_dist.comm.collectives import ring_perm
 
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
