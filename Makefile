@@ -40,3 +40,6 @@ bench:
 
 runtime:
 	$(MAKE) -C tpu_dist/runtime
+
+train-lm:
+	cd demos && $(PY) train_lm.py $(DEMOFLAGS)
