@@ -1,0 +1,119 @@
+"""Expert parallelism: the distributed MoE must match a dense reference
+implementation of the same routing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import spmd_run as run
+from tpu_dist import comm, parallel
+from tpu_dist.parallel.moe import capacity_for, moe_mlp, stack_expert_params
+
+N = 4  # experts = ranks
+D, H, T = 8, 16, 12  # dim, hidden, tokens per rank
+
+
+def _setup(seed=0):
+    key = jax.random.key(seed)
+    kg, kx, *ke = jax.random.split(key, 2 + 2 * N)
+    gate_w = jax.random.normal(kg, (D, N))
+    experts = [
+        {
+            "up": jax.random.normal(ke[2 * i], (D, H)) / np.sqrt(D),
+            "down": jax.random.normal(ke[2 * i + 1], (H, D)) / np.sqrt(H),
+        }
+        for i in range(N)
+    ]
+    xs = jax.random.normal(kx, (N, T, D))  # per-rank token shards
+    return gate_w, experts, xs
+
+
+def _dense_reference(gate_w, experts, xs, capacity_factor=1.25):
+    """Same routing/capacity semantics, computed with plain numpy loops."""
+    cap = capacity_for(T, N, capacity_factor)
+    out = np.zeros_like(np.asarray(xs))
+    for r in range(N):  # source rank
+        x = np.asarray(xs[r])
+        scores = x @ np.asarray(gate_w)
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        assign = scores.argmax(-1)
+        counts = {e: 0 for e in range(N)}
+        for t in range(T):
+            e = int(assign[t])
+            if counts[e] < cap:
+                h = np.tanh(0)  # placeholder, replaced below
+                up, down = np.asarray(experts[e]["up"]), np.asarray(experts[e]["down"])
+                hidden = jax.nn.gelu(jnp.asarray(x[t] @ up))
+                y = np.asarray(hidden) @ down
+                out[r, t] = probs[t, e] * y
+                counts[e] += 1
+    return out
+
+
+def test_moe_matches_dense_reference():
+    gate_w, experts, xs = _setup()
+    stacked = stack_expert_params(experts)
+
+    def fn(gate_w, stacked, xs):
+        r = comm.rank()
+        x_local = jax.lax.dynamic_index_in_dim(xs, r, 0, keepdims=False)
+        up = jax.lax.dynamic_index_in_dim(stacked["up"], r, 0, keepdims=False)
+        down = jax.lax.dynamic_index_in_dim(stacked["down"], r, 0, keepdims=False)
+        y, stats = moe_mlp(
+            x_local, gate_w, up, down, axis_name=comm.DEFAULT_AXIS
+        )
+        return y, stats["dropped_fraction"]
+
+    out, dropped = run(fn, gate_w, stacked, xs, world=N)
+    expect = _dense_reference(gate_w, experts, xs)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4, atol=1e-5)
+    assert float(np.asarray(dropped).max()) <= 1.0
+
+
+def test_moe_differentiable():
+    gate_w, experts, xs = _setup(1)
+    stacked = stack_expert_params(experts)
+
+    def fn(gate_w, stacked, xs):
+        r = comm.rank()
+
+        def loss(args):
+            gw, st = args
+            x_local = jax.lax.dynamic_index_in_dim(xs, r, 0, keepdims=False)
+            up = jax.lax.dynamic_index_in_dim(st["up"], r, 0, keepdims=False)
+            down = jax.lax.dynamic_index_in_dim(st["down"], r, 0, keepdims=False)
+            y, _ = moe_mlp(x_local, gw, up, down, axis_name=comm.DEFAULT_AXIS)
+            return jnp.sum(y**2)
+
+        g = jax.grad(loss)((gate_w, stacked))
+        return g
+
+    g_gate, g_exp = run(fn, gate_w, stacked, xs, world=N)
+    assert np.isfinite(np.asarray(g_gate)).all()
+    assert any(
+        float(np.abs(np.asarray(leaf)).max()) > 0
+        for leaf in jax.tree.leaves(g_exp)
+    ), "expert grads must be nonzero"
+
+
+def test_capacity_drops_overflow():
+    """With capacity_factor tiny, most tokens are dropped -> zeros in the
+    output and a reported dropped fraction > 0."""
+    gate_w, experts, xs = _setup(2)
+    stacked = stack_expert_params(experts)
+
+    def fn(gate_w, stacked, xs):
+        r = comm.rank()
+        x_local = jax.lax.dynamic_index_in_dim(xs, r, 0, keepdims=False)
+        up = jax.lax.dynamic_index_in_dim(stacked["up"], r, 0, keepdims=False)
+        down = jax.lax.dynamic_index_in_dim(stacked["down"], r, 0, keepdims=False)
+        y, stats = moe_mlp(
+            x_local, gate_w, up, down,
+            axis_name=comm.DEFAULT_AXIS, capacity_factor=0.34,
+        )
+        return stats["dropped_fraction"]
+
+    dropped = np.asarray(run(fn, gate_w, stacked, xs, world=N))
+    assert dropped.max() > 0.0

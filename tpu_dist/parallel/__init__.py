@@ -18,6 +18,16 @@ from tpu_dist.parallel.ring_attention import (
     RingMultiHeadAttention,
     ring_attention,
 )
+from tpu_dist.parallel.moe import (
+    EXPERT_AXIS,
+    moe_mlp,
+    stack_expert_params,
+)
+from tpu_dist.parallel.pipeline import (
+    PIPE_AXIS,
+    pipeline_apply,
+    stack_stage_params,
+)
 from tpu_dist.parallel.ulysses import ulysses_attention
 from tpu_dist.parallel.tensor_parallel import (
     MODEL_AXIS,
@@ -35,7 +45,13 @@ from tpu_dist.parallel.ring import (
 
 __all__ = [
     "DATA_AXIS",
+    "EXPERT_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
+    "moe_mlp",
+    "pipeline_apply",
+    "stack_expert_params",
+    "stack_stage_params",
     "RingMultiHeadAttention",
     "average_gradients",
     "column_parallel",

@@ -1,0 +1,109 @@
+"""Expert parallelism — a mixture-of-experts MLP sharded one expert per
+rank, with all_to_all token dispatch.
+
+Listed as a non-goal in SURVEY.md §2d (the reference has no MoE);
+implemented so the expert-parallel row of the parallelism table is a
+working configuration.  Scheme (top-1 routing, capacity-bounded —
+Switch-Transformer style):
+
+1. every rank routes its LOCAL tokens: ``argmax(x @ gate_w)`` picks an
+   expert, softmax gives the combine weight;
+2. tokens are packed into a ``(n_experts, capacity, d)`` dispatch buffer
+   (position = running count within the expert; overflow beyond capacity
+   is dropped — standard MoE behavior, surfaced in the aux stats);
+3. ONE ``all_to_all`` ships row e of every rank to rank e (the expert's
+   owner), which runs its expert MLP on all arriving tokens;
+4. a second ``all_to_all`` ships results back, and tokens are combined
+   into their original positions scaled by the gate weight (dropped
+   tokens contribute zero — use MoE layers residually).
+
+Everything is static-shaped (capacity bound), so the whole layer compiles
+into the surrounding SPMD program; both all_to_alls ride ICI.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.comm.collectives import all_to_all
+
+EXPERT_AXIS = "expert"
+
+
+def capacity_for(tokens_per_rank: int, n_experts: int, factor: float = 1.25) -> int:
+    """Per-expert per-source-rank slot count."""
+    return max(1, math.ceil(tokens_per_rank / n_experts * factor))
+
+
+def moe_mlp(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    axis_name: str = EXPERT_AXIS,
+    capacity_factor: float = 1.25,
+    activation=jax.nn.gelu,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Top-1 MoE MLP inside shard_map over ``axis_name``.
+
+    Args:
+      x: local token shard ``(T, d)`` (tokens sharded over the same axis).
+      gate_w: replicated router weights ``(d, n_experts)``.
+      w_up, w_down: THIS rank's expert parameters ``(d, hidden)`` /
+        ``(hidden, d)`` (i.e. the local slice of expert-stacked weights).
+
+    Returns ``(y, stats)`` with ``y: (T, d)`` — the gated expert outputs
+    (zeros for dropped tokens) — and routing stats (fraction dropped,
+    per-expert load).
+    """
+    n = lax.axis_size(axis_name)
+    T, d = x.shape
+    cap = capacity_for(T, n, capacity_factor)
+
+    scores = x @ gate_w  # (T, n)
+    probs = jax.nn.softmax(scores, axis=-1)
+    assign = jnp.argmax(scores, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
+
+    onehot = jax.nn.one_hot(assign, n, dtype=jnp.int32)  # (T, n)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # (T, n), -1 elsewhere
+    pos_in_expert = pos.max(axis=1)  # (T,)
+    kept = pos_in_expert < cap
+    load = onehot.sum(axis=0)  # tokens per expert from this rank
+
+    # Pack: dispatch[e, c] = the token assigned to expert e at slot c.
+    dispatch = jnp.zeros((n, cap, d), x.dtype)
+    dispatch = dispatch.at[
+        assign, jnp.clip(pos_in_expert, 0, cap - 1)
+    ].add(jnp.where(kept[:, None], x, 0.0))
+
+    # Ship: row e -> rank e.  Arrives as (n_src, cap, d) stacked by source.
+    arriving = all_to_all(dispatch, axis_name, split_axis=0, concat_axis=0)
+    flat = arriving.reshape(n * cap, d)
+    hidden = activation(flat @ w_up)
+    processed = (hidden @ w_down).reshape(n, cap, d)
+
+    # Ship back: row s of the result returns to source rank s, stacked by
+    # expert again: returned[e, c] = expert e's output for my slot c.
+    returned = all_to_all(processed, axis_name, split_axis=0, concat_axis=0)
+
+    # Combine into original token positions.
+    out_tokens = returned[assign, jnp.clip(pos_in_expert, 0, cap - 1)]
+    y = jnp.where(kept[:, None], out_tokens * gate[:, None], 0.0)
+    stats = {
+        "dropped_fraction": 1.0 - kept.mean(),
+        "local_load": load,
+    }
+    return y, stats
+
+
+def stack_expert_params(experts: list[dict[str, Any]]) -> dict[str, Any]:
+    """Stack per-expert param dicts on a leading axis (shard with
+    ``P('expert')`` entering shard_map)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *experts)

@@ -1,0 +1,116 @@
+"""Pipeline parallelism — GPipe-style microbatching over a ``pipe`` mesh
+axis.
+
+Listed as a non-goal for parity in SURVEY.md §2d (the reference has no
+model big enough to split); implemented here so every row of the
+parallelism table is expressible, not just "the mesh could".  Design:
+
+- The model is split into ``n`` *stages* with uniform activation shapes
+  (e.g. transformer blocks).  Under ``shard_map`` over the ``pipe`` axis,
+  each rank holds ONE stage's parameters (stacked pytree sharded on its
+  leading axis).
+- The global batch is split into ``M`` microbatches.  The schedule runs
+  ``M + n - 1`` lockstep ticks: at tick ``t``, stage ``s`` processes
+  microbatch ``t - s`` (when valid) and hands its activation to stage
+  ``s+1`` via the same neighbor ``ppermute`` the ring collectives use.
+  Bubble fraction is the usual ``(n-1)/(M+n-1)``.
+- Every rank executes the same compiled program (SPMD); validity is
+  masking, not control flow — XLA-friendly by construction.
+
+`pipeline_apply` is forward-only scheduling; because it is pure JAX, the
+whole schedule differentiates (backward replays the scan in reverse), so
+it composes with `jax.grad`/train steps — tested.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.comm.collectives import ring_perm
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(stage_params: list[Any]) -> Any:
+    """Stack per-stage parameter pytrees on a new leading axis (shard it
+    over the ``pipe`` axis with ``P('pipe')`` when entering shard_map)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    params_local: Any,
+    x: jax.Array,
+    *,
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+) -> jax.Array:
+    """Run the staged model over the pipeline.
+
+    Args:
+      stage_fn: ``(stage_params, activation) -> activation`` — this rank's
+        stage.  Activation shapes must be uniform across stages.
+      params_local: this rank's stage parameters (inside shard_map: the
+        local slice of the stacked pytree, leading stage axis of size 1 is
+        squeezed by the caller or carried — see `tests/test_pipeline.py`).
+      x: the FULL local batch ``(B, ...)`` (replicated input); it is split
+        into ``n_microbatches`` microbatches of ``B // n_microbatches``.
+      n_microbatches: M; must divide B.
+
+    Returns the full output batch ``(B, ...)``, valid on every rank (the
+    last stage's results are broadcast back over the ring as part of the
+    drain, costing nothing extra in program count).
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(
+            f"batch {B} not divisible by n_microbatches {n_microbatches}"
+        )
+    mb = B // n_microbatches
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+    perm = ring_perm(n)
+    ticks = n_microbatches + n - 1
+
+    out0 = jnp.zeros_like(micro)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # Stage 0 injects microbatch t (zeros once drained); others use
+        # what arrived from the left neighbor last tick.
+        inject_idx = jnp.clip(t, 0, n_microbatches - 1)
+        injected = lax.dynamic_index_in_dim(micro, inject_idx, 0, keepdims=False)
+        x_in = jnp.where(s == 0, injected, buf)
+        y = stage_fn(params_local, x_in)
+        # Last stage: write microbatch t - (n-1) when valid.
+        out_idx = jnp.clip(t - (n - 1), 0, n_microbatches - 1)
+        valid = (s == n - 1) & (t >= n - 1)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(valid, y, lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)),
+            out_idx,
+            0,
+        )
+        # activations flow right around the ring (the last->first hop
+        # carries garbage that stage 0 ignores — it injects instead)
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, updated), None
+
+    init = (jnp.zeros((mb,) + x.shape[1:], x.dtype), out0)
+    (final_buf, outputs), _ = lax.scan(tick, init, jnp.arange(ticks))
+    # Everyone needs the result (losses are usually computed replicated):
+    # take the last stage's outputs via a masked psum.
+    outputs = jnp.where(s == n - 1, outputs, jnp.zeros_like(outputs))
+    outputs = lax.psum(outputs, axis_name)
+    # Replicated-loss gradient convention: every rank recomputes the SAME
+    # loss from these replicated outputs, and the transpose of the psum
+    # above sums all n identical cotangents — n× the true gradient.
+    # Scale the differentiable path by 1/n (forward value unchanged) so
+    # grads through pipeline_apply equal sequential-execution grads.
+    outputs = outputs / n + lax.stop_gradient(outputs * (n - 1) / n)
+    return outputs.reshape((B,) + x.shape[1:])
