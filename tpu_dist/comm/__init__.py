@@ -9,6 +9,7 @@ from tpu_dist.comm.collectives import (
     ReduceOp,
     all_gather,
     all_reduce,
+    all_reduce_quantized,
     all_to_all,
     barrier,
     broadcast,
@@ -41,6 +42,7 @@ __all__ = [
     "ReduceOp",
     "all_gather",
     "all_reduce",
+    "all_reduce_quantized",
     "all_to_all",
     "barrier",
     "broadcast",

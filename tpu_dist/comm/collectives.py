@@ -284,6 +284,31 @@ def all_to_all(
     )
 
 
+def all_reduce_quantized(
+    x: jax.Array,
+    axis_name: str = DEFAULT_AXIS,
+) -> jax.Array:
+    """Bandwidth-compressed all-reduce: int8 payloads + one f32 scale per
+    rank (EQuARX-style quantized collective — see PAPERS.md; 4× less
+    interconnect traffic than an f32 all-reduce at ~0.4% relative error
+    for well-scaled tensors).
+
+    Each rank quantizes symmetrically (scale = max|x| / 127), ships int8,
+    and the sum is reconstructed in f32 from the gathered (q, scale)
+    pairs.  Lossy — intended for gradient averaging where int8 error is
+    far below gradient noise; use `all_reduce` where exactness matters.
+    """
+    flat = x.reshape(-1)
+    scale = jnp.max(jnp.abs(flat)) / 127.0 + 1e-30
+    q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
+    qs = lax.all_gather(q, axis_name, axis=0)  # (n, T) int8 on the wire
+    scales = lax.all_gather(scale, axis_name, axis=0)  # (n,) f32
+    total = jnp.einsum(
+        "nt,n->t", qs.astype(jnp.float32), scales.astype(jnp.float32)
+    )
+    return total.reshape(x.shape).astype(x.dtype)
+
+
 def ring_perm(n: int) -> list[tuple[int, int]]:
     """The neighbor ring: every rank sends right, receives from left
     (allreduce.py:18-20).  Shared by `shift`, the ring allreduce, and ring
