@@ -93,6 +93,44 @@ class TestPallasMatmul:
         )
 
 
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("shape", [(1, 2, 64, 16), (2, 3, 128, 8)])
+    def test_matches_reference(self, causal, shape):
+        from tpu_dist.nn import dot_product_attention
+
+        ks = jax.random.split(jax.random.key(0), 3)
+        q, k, v = (jax.random.normal(kk, shape) for kk in ks)
+        out = ops.flash_attention(
+            q, k, v, causal=causal, bq=32, bk=32, interpret=True
+        )
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_block_clamping_small_seq(self):
+        from tpu_dist.nn import dot_product_attention
+
+        q = jax.random.normal(jax.random.key(1), (1, 1, 8, 4))
+        out = ops.flash_attention(q, q, q, interpret=True)  # blocks clamp to 8
+        ref = dot_product_attention(q, q, q)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_indivisible_raises(self):
+        q = jnp.ones((1, 1, 48, 4))
+        with pytest.raises(ValueError, match="not divisible"):
+            ops.flash_attention(q, q, q, bq=32, bk=32, interpret=True)
+
+    def test_shape_mismatch_raises(self):
+        q = jnp.ones((1, 1, 32, 4))
+        k = jnp.ones((1, 1, 16, 4))
+        with pytest.raises(ValueError, match="shapes differ"):
+            ops.flash_attention(q, k, k, interpret=True)
+
+
 class TestPallasRing:
     def test_falls_back_off_tpu(self):
         """On CPU the RDMA kernel is not executable; the entry point must

@@ -7,7 +7,13 @@
   TPU-only, ppermute fallback elsewhere.
 """
 
+from tpu_dist.ops.flash_attention import flash_attention
 from tpu_dist.ops.matmul import matmul, use_pallas_dense
 from tpu_dist.ops.pallas_ring import ring_all_reduce_pallas
 
-__all__ = ["matmul", "ring_all_reduce_pallas", "use_pallas_dense"]
+__all__ = [
+    "flash_attention",
+    "matmul",
+    "ring_all_reduce_pallas",
+    "use_pallas_dense",
+]

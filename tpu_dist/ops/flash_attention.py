@@ -1,0 +1,114 @@
+"""Blockwise (flash-style) attention as a Pallas kernel.
+
+The single-device counterpart of `tpu_dist.parallel.ring_attention`: the
+same streaming-softmax recurrence (running max / denominator / numerator
+in f32), but blocked over the KEY dimension inside one chip's VMEM instead
+of over ring hops between chips — the (S, S) score matrix is never
+materialized in HBM.  Grid: one program per (batch·head, query-block);
+each program scans key/value blocks with ``lax.fori_loop``.
+
+Interpret-mode tested against `tpu_dist.nn.dot_product_attention` on CPU;
+compiled on TPU.  Forward-only (wrap in `jax.checkpoint` + autodiff via
+recompute, or use the XLA path for training; a custom bwd kernel is a
+round-2 item — ROADMAP.md).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bk: int, causal: bool):
+    q = q_ref[0].astype(jnp.float32)  # (bq, d)
+    bq, d = q.shape
+    S = k_ref.shape[1]
+    scale = d**-0.5
+    qs = q * scale
+    i = pl.program_id(1)
+    nblocks = S // bk
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        logits = jnp.dot(qs, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        m_new = jnp.maximum(m, logits.max(-1))
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        l_new = l * correction + p.sum(-1)
+        acc_new = acc * correction[:, None] + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nblocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "bq", "bk", "interpret")
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    bq: int = 256,
+    bk: int = 256,
+    interpret: bool = False,
+) -> jax.Array:
+    """Attention over (..., heads, S, d) without materializing (S, S).
+
+    Block sizes clamp to the sequence length for small inputs; S must be
+    divisible by the (clamped) block sizes.
+    """
+    *lead, S, d = q.shape
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    bq = min(bq, S)
+    bk = min(bk, S)
+    if S % bq or S % bk:
+        raise ValueError(f"seq {S} not divisible by blocks ({bq}, {bk})")
+    bh = 1
+    for x in lead:
+        bh *= x
+    q3 = q.reshape(bh, S, d)
+    k3 = k.reshape(bh, S, d)
+    v3 = v.reshape(bh, S, d)
+    kernel = functools.partial(_flash_kernel, bk=bk, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, S, d), q.dtype),
+        compiler_params=None
+        if interpret
+        else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out.reshape(q.shape)
