@@ -100,20 +100,22 @@ def test_ring_grad_reduce_matches_psum_training():
 
 
 def test_quantized_allreduce_error_bound():
-    """int8 all-reduce must agree with exact psum to ~1% relative error
-    on well-scaled inputs."""
+    """int8 all-reduce must agree with exact psum to ~1% of the tensor
+    scale (quantization error is absolute — a fraction of max|x| — so
+    near-zero components are excluded from 'relative' claims)."""
 
     def fn():
-        x = jax.random.normal(jax.random.key(comm.rank()[()] * 0 + 3), (512,))
+        x = jax.random.normal(jax.random.key(3), (512,))
         x = x * (comm.rank() + 1.0)
         exact = comm.all_reduce(x)
         approx = comm.all_reduce_quantized(x)
-        denom = jnp.maximum(jnp.abs(exact), 1e-3)
-        return jnp.max(jnp.abs(approx - exact) / denom), jnp.max(
-            jnp.abs(approx - exact)
-        )
+        scale_rel = jnp.max(jnp.abs(approx - exact)) / jnp.max(jnp.abs(exact))
+        return scale_rel, jnp.max(jnp.abs(approx - exact))
 
     rel, absd = run(fn, world=8)
+    # error relative to the tensor's scale: ~2/127 worst case for the two
+    # quantization rounds
+    assert float(np.asarray(rel).max()) < 0.02
     # absolute error bounded by sum of per-rank quantization steps
     assert float(np.asarray(absd).max()) < 8 * (8 * 3.0 / 127)
 

@@ -43,7 +43,6 @@ def _dense_reference(gate_w, experts, xs, capacity_factor=1.25):
         for t in range(T):
             e = int(assign[t])
             if counts[e] < cap:
-                h = np.tanh(0)  # placeholder, replaced below
                 up, down = np.asarray(experts[e]["up"]), np.asarray(experts[e]["down"])
                 hidden = jax.nn.gelu(jnp.asarray(x[t] @ up))
                 y = np.asarray(hidden) @ down

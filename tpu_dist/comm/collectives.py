@@ -284,29 +284,58 @@ def all_to_all(
     )
 
 
+def _quantize_i8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    scale = jnp.max(jnp.abs(x)) / 127.0 + 1e-30
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def all_reduce_quantized(
     x: jax.Array,
     axis_name: str = DEFAULT_AXIS,
 ) -> jax.Array:
-    """Bandwidth-compressed all-reduce: int8 payloads + one f32 scale per
-    rank (EQuARX-style quantized collective — see PAPERS.md; 4× less
-    interconnect traffic than an f32 all-reduce at ~0.4% relative error
-    for well-scaled tensors).
+    """Bandwidth-compressed all-reduce: int8 payloads, O(size) wire
+    traffic (EQuARX-style quantized collective — see PAPERS.md).
 
-    Each rank quantizes symmetrically (scale = max|x| / 127), ships int8,
-    and the sum is reconstructed in f32 from the gathered (q, scale)
-    pairs.  Lossy — intended for gradient averaging where int8 error is
-    far below gradient noise; use `all_reduce` where exactness matters.
+    Structure mirrors the bandwidth-optimal allreduce: a quantized
+    REDUCE-SCATTER (all_to_all of int8 chunks + per-chunk scales; each
+    rank dequantizes and sums its chunk) followed by a quantized
+    ALL-GATHER of the re-quantized reduced chunks.  Each rank ships
+    ~2·(n-1)/n·size int8 bytes total — ~4× less than the f32 ring at any
+    world size (the naive all-gather formulation would grow O(n·size) and
+    lose to exact f32 beyond n≈8).
+
+    Lossy: two quantization rounds put the error at ~1-2% of the TENSOR
+    SCALE (max|result|) — absolute, not per-component, so near-zero
+    entries carry the same absolute error.  Intended for gradient
+    averaging, where that sits below gradient noise; use `all_reduce`
+    where exactness matters.
     """
+    n = lax.axis_size(axis_name)
     flat = x.reshape(-1)
-    scale = jnp.max(jnp.abs(flat)) / 127.0 + 1e-30
-    q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
-    qs = lax.all_gather(q, axis_name, axis=0)  # (n, T) int8 on the wire
-    scales = lax.all_gather(scale, axis_name, axis=0)  # (n,) f32
-    total = jnp.einsum(
-        "nt,n->t", qs.astype(jnp.float32), scales.astype(jnp.float32)
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(n, -1)  # chunk c destined for rank c
+    # Per-chunk symmetric quantization (one scale per destination chunk).
+    scales = jnp.max(jnp.abs(chunks), axis=1) / 127.0 + 1e-30
+    q = jnp.clip(
+        jnp.round(chunks / scales[:, None]), -127, 127
+    ).astype(jnp.int8)
+    # Quantized reduce-scatter: rank r receives every rank's chunk r.
+    q_in = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    s_in = lax.all_to_all(
+        scales.reshape(n, 1), axis_name, split_axis=0, concat_axis=0, tiled=True
     )
-    return total.reshape(x.shape).astype(x.dtype)
+    reduced = jnp.einsum(
+        "nc,n->c", q_in.astype(jnp.float32), s_in[:, 0].astype(jnp.float32)
+    )
+    # Quantized all-gather of the reduced chunk.
+    q2, s2 = _quantize_i8(reduced)
+    q_all = lax.all_gather(q2, axis_name, axis=0)  # (n, C) int8
+    s_all = lax.all_gather(s2, axis_name, axis=0)  # (n,)
+    total = (q_all.astype(jnp.float32) * s_all[:, None]).reshape(-1)
+    return total[: x.size].reshape(x.shape).astype(x.dtype)
 
 
 def ring_perm(n: int) -> list[tuple[int, int]]:

@@ -106,4 +106,6 @@ def moe_mlp(
 def stack_expert_params(experts: list[dict[str, Any]]) -> dict[str, Any]:
     """Stack per-expert param dicts on a leading axis (shard with
     ``P('expert')`` entering shard_map)."""
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *experts)
+    from tpu_dist.utils.tree import stack_pytrees
+
+    return stack_pytrees(experts)

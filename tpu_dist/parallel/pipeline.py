@@ -38,7 +38,9 @@ PIPE_AXIS = "pipe"
 def stack_stage_params(stage_params: list[Any]) -> Any:
     """Stack per-stage parameter pytrees on a new leading axis (shard it
     over the ``pipe`` axis with ``P('pipe')`` when entering shard_map)."""
-    return jax.tree.map(lambda *xs: jnp.stack(xs), *stage_params)
+    from tpu_dist.utils.tree import stack_pytrees
+
+    return stack_pytrees(stage_params)
 
 
 def pipeline_apply(

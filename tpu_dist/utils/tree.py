@@ -36,3 +36,10 @@ def global_norm(tree) -> jax.Array:
 
 def tree_cast(tree, dtype):
     return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def stack_pytrees(trees):
+    """Stack a list of same-structure pytrees on a new leading axis
+    (e.g. per-stage or per-expert params, sharded over that axis when
+    entering shard_map)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
