@@ -113,6 +113,19 @@ def test_trace_dir_writes_profile(tmp_path, mesh, dataset):
     assert found, "profiler trace directory is empty"
 
 
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    import jax.numpy as jnp
+
+    tree = {"a": jnp.arange(6.0).reshape(2, 3), "b": {"c": jnp.ones(4)}}
+    train.checkpoint.save_orbax(tmp_path / "ck", tree, step=7)
+    got, step = train.checkpoint.restore_orbax(
+        tmp_path / "ck", jax.tree.map(jnp.zeros_like, tree)
+    )
+    assert step == 7
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_checkpoint_structure_mismatch_raises(tmp_path, mesh):
     t = _make_trainer(mesh, epochs=1)
     ckpt = tmp_path / "state.npz"

@@ -26,7 +26,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bk: int, causal: bool):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int, causal: bool):
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     bq, d = q.shape
     S = k_ref.shape[1]
@@ -66,6 +66,94 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, bk: int, causal: bool):
         hi = nblocks
     m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    # log-sum-exp per query row (saved for the backward pass)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_forward(q3, k3, v3, causal, bq, bk, interpret):
+    bh, S, d = q3.shape
+    kernel = functools.partial(_flash_kernel, bk=bk, causal=causal)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, S, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, S), jnp.float32),
+        ],
+        compiler_params=None
+        if interpret
+        else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, causal, bq, bk, interpret):
+    out, _ = _flash_forward(q3, k3, v3, causal, bq, bk, interpret)
+    return out
+
+
+def _flash_fwd(q3, k3, v3, causal, bq, bk, interpret):
+    out, lse = _flash_forward(q3, k3, v3, causal, bq, bk, interpret)
+    return out, (q3, k3, v3, out, lse)
+
+
+def _flash_bwd(causal, bq, bk, interpret, res, g):
+    """Blockwise backward in plain XLA: one `lax.scan` over key blocks,
+    peak intermediate (S, bk) — the (S, S) score matrix is never formed.
+    Standard flash recurrence: with P = exp(logits - lse) and
+    D = rowsum(dO ∘ O),  dV_j = Pᵀ dO,  dS = P ∘ (dO Vᵀ − D),
+    dQ += dS K_j · scale,  dK_j = dSᵀ Q · scale."""
+    q3, k3, v3, out, lse = res
+    bh, S, d = q3.shape
+    scale = d**-0.5
+    qf = q3.astype(jnp.float32)
+    kf = k3.astype(jnp.float32)
+    vf = v3.astype(jnp.float32)
+    go = g.astype(jnp.float32)
+    D = jnp.sum(go * out.astype(jnp.float32), axis=-1)  # (bh, S)
+    nb = S // bk
+    pos_q = jnp.arange(S)
+
+    def block(carry, j):
+        dq = carry
+        ks = lax.dynamic_slice_in_dim(kf, j * bk, bk, 1)  # (bh, bk, d)
+        vs = lax.dynamic_slice_in_dim(vf, j * bk, bk, 1)
+        logits = jnp.einsum("bsd,btd->bst", qf * scale, ks)  # (bh, S, bk)
+        if causal:
+            pos_k = j * bk + jnp.arange(bk)
+            mask = pos_q[:, None] >= pos_k[None, :]
+            logits = jnp.where(mask, logits, NEG_INF)
+        p = jnp.exp(logits - lse[..., None])  # (bh, S, bk)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dv = jnp.einsum("bst,bsd->btd", p, go)
+        dp = jnp.einsum("bsd,btd->bst", go, vs)
+        ds = p * (dp - D[..., None])
+        dq = dq + jnp.einsum("bst,btd->bsd", ds, ks) * scale
+        dk = jnp.einsum("bst,bsd->btd", ds, qf) * scale
+        return dq, (dk, dv)
+
+    dq, (dks, dvs) = lax.scan(block, jnp.zeros_like(qf), jnp.arange(nb))
+    # scan stacks per-block dk/dv as (nb, bh, bk, d) -> (bh, S, d)
+    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, S, d)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, S, d)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
@@ -84,7 +172,9 @@ def flash_attention(
     """Attention over (..., heads, S, d) without materializing (S, S).
 
     Block sizes clamp to the sequence length for small inputs; S must be
-    divisible by the (clamped) block sizes.
+    divisible by the (clamped) block sizes.  Differentiable: the custom
+    VJP runs the standard flash backward blockwise (peak intermediate
+    (S, bk)), using the LSE saved by the forward kernel.
     """
     *lead, S, d = q.shape
     if q.shape != k.shape or q.shape != v.shape:
@@ -99,22 +189,5 @@ def flash_attention(
     q3 = q.reshape(bh, S, d)
     k3 = k.reshape(bh, S, d)
     v3 = v.reshape(bh, S, d)
-    kernel = functools.partial(_flash_kernel, bk=bk, causal=causal)
-    out = pl.pallas_call(
-        kernel,
-        grid=(bh, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, S, d), q.dtype),
-        compiler_params=None
-        if interpret
-        else pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel")
-        ),
-        interpret=interpret,
-    )(q3, k3, v3)
+    out = _flash(q3, k3, v3, causal, bq, bk, interpret)
     return out.reshape(q.shape)

@@ -42,6 +42,25 @@ def save(path: str | Path, tree: Any, *, step: int = 0) -> None:
     tmp.rename(path)
 
 
+def save_orbax(path: str | Path, tree: Any, *, step: int = 0) -> None:
+    """Alternative backend: orbax (async-capable, sharding-aware) for
+    users standardized on it.  Same single-writer contract as `save`."""
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, {"tree": tree, "step": step}, force=True)
+
+
+def restore_orbax(path: str | Path, like: Any) -> tuple[Any, int]:
+    import orbax.checkpoint as ocp
+
+    path = Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(path, {"tree": like, "step": 0})
+    return state["tree"], int(state["step"])
+
+
 def restore(path: str | Path, like: Any) -> tuple[Any, int]:
     """Restore into the structure of ``like`` (a template pytree with the
     same treedef, e.g. freshly-initialized params).  Returns
