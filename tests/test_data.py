@@ -91,6 +91,30 @@ class TestDistributedLoader:
             data.DistributedLoader(FakeDataset(64), 3, 128)
 
 
+class TestPrefetch:
+    def test_prefetch_preserves_order_and_count(self):
+        from tpu_dist import comm
+
+        mesh = comm.make_mesh(8, ("data",), platform="cpu")
+        ds = data.synthetic_mnist(512)
+        dl = data.DistributedLoader(ds, 8, 128)
+        plain = [(x.copy(), y.copy()) for x, y in dl.epoch(0)]
+        fetched = list(data.prefetch_to_mesh(dl.epoch(0), mesh))
+        assert len(fetched) == len(plain)
+        for (px, py), (fx, fy) in zip(plain, fetched):
+            np.testing.assert_array_equal(px, np.asarray(fx))
+            np.testing.assert_array_equal(py, np.asarray(fy))
+
+    def test_prefetch_short_iterator(self):
+        from tpu_dist import comm
+
+        mesh = comm.make_mesh(8, ("data",), platform="cpu")
+        ds = data.synthetic_mnist(128)
+        dl = data.DistributedLoader(ds, 8, 128)  # exactly 1 batch
+        fetched = list(data.prefetch_to_mesh(dl.epoch(0), mesh, depth=4))
+        assert len(fetched) == 1
+
+
 class TestMnist:
     def test_synthetic_deterministic(self):
         a = data.synthetic_mnist(100)

@@ -86,6 +86,18 @@ def test_mha_shapes_and_causality():
     np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(y2[:, 0]), atol=1e-6)
 
 
+def test_flash_flag_in_dot_product_attention(monkeypatch):
+    """TPU_DIST_FLASH=1 routes long sequences through the flash kernel;
+    results match the dense path."""
+    q = jax.random.normal(jax.random.key(0), (1, 2, 128, 16))
+    dense = nn.dot_product_attention(q, q, q, causal=True)
+    monkeypatch.setenv("TPU_DIST_FLASH", "1")
+    flash = nn.dot_product_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(flash), np.asarray(dense), rtol=2e-5, atol=2e-5
+    )
+
+
 def test_losses_known_values():
     logp = jnp.log(jnp.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
     targets = jnp.array([0, 1])

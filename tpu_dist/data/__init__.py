@@ -1,7 +1,7 @@
 """`tpu_dist.data` — partitioning and loading (SURVEY.md §1 L4)."""
 
 from tpu_dist.data.cifar import load_cifar10, synthetic_cifar10
-from tpu_dist.data.loader import DistributedLoader, Loader
+from tpu_dist.data.loader import DistributedLoader, Loader, prefetch_to_mesh
 from tpu_dist.data.mnist import (
     Dataset,
     load_idx_images,
@@ -22,6 +22,7 @@ __all__ = [
     "load_idx_images",
     "load_idx_labels",
     "load_mnist",
+    "prefetch_to_mesh",
     "synthetic_cifar10",
     "synthetic_mnist",
 ]

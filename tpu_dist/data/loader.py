@@ -127,3 +127,32 @@ class DistributedLoader:
             x = np.concatenate([p[0] for p in parts])
             y = np.concatenate([p[1] for p in parts])
             yield x, y
+
+
+def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, axis_name: str = "data"):
+    """Overlap host batch assembly + H2D transfer with device compute.
+
+    Wraps a host batch iterator: batches are `shard_batch`-placed onto the
+    mesh ``depth`` steps ahead (device_put is async), so the accelerator
+    never waits on the input pipeline — the torch-DataLoader-workers role
+    (the reference relies on torch's native prefetching loader,
+    train_dist.py:89) played by XLA's async transfers.
+    """
+    import collections
+
+    from tpu_dist.parallel.data_parallel import shard_batch
+
+    queue = collections.deque()
+    it = iter(iterator)
+    try:
+        for _ in range(depth):
+            queue.append(shard_batch(next(it), mesh, axis_name))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(shard_batch(next(it), mesh, axis_name))
+        except StopIteration:
+            pass
+        yield out

@@ -24,7 +24,19 @@ def dot_product_attention(
     *,
     causal: bool = False,
 ) -> jax.Array:
-    """Softmax attention. Shapes: (..., heads, seq, head_dim)."""
+    """Softmax attention. Shapes: (..., heads, seq, head_dim).
+
+    With ``TPU_DIST_FLASH=1`` the blockwise Pallas kernel
+    (`tpu_dist.ops.flash_attention`) takes over for sequences past its
+    block size — no (S, S) materialization; numerics match to fp
+    tolerance (differentiable either way)."""
+    import os
+
+    if os.environ.get("TPU_DIST_FLASH", "0") == "1" and q.shape[-2] >= 128:
+        from tpu_dist.ops.flash_attention import flash_attention
+
+        interp = jax.default_backend() != "tpu"
+        return flash_attention(q, k, v, causal=causal, interpret=interp)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("...hqd,...hkd->...hqk", q * scale, k)
     if causal:

@@ -188,9 +188,14 @@ class Trainer:
         for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
             t0 = time.perf_counter()
             total_loss, num_batches = 0.0, 0
+            from tpu_dist.data.loader import prefetch_to_mesh
+
             with metrics_mod.trace(trace_dir if epoch == start_epoch else None):
-                for bi, (x, y) in enumerate(loader.epoch(epoch)):
-                    batch = parallel.shard_batch((x, y), self.mesh)
+                batches = prefetch_to_mesh(
+                    loader.epoch(epoch), self.mesh,
+                    axis_name=self.mesh.axis_names[0],
+                )
+                for bi, batch in enumerate(batches):
                     key = jax.random.fold_in(step_key, epoch * 100000 + bi)
                     (
                         self.params,
