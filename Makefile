@@ -43,3 +43,6 @@ runtime:
 
 train-lm:
 	cd demos && $(PY) train_lm.py $(DEMOFLAGS)
+
+docs:
+	$(PY) tools/render_docs.py

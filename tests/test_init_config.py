@@ -1,0 +1,86 @@
+"""Bootstrap config: the MASTER_ADDR/PORT/WORLD_SIZE/RANK env contract
+(tuto.md:421-428 analog) and 2-D-mesh collective coverage."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_dist import comm
+from tpu_dist.comm.init import InitConfig
+
+
+class TestInitConfig:
+    def test_from_env_full(self, monkeypatch):
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "29500")
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        monkeypatch.setenv("RANK", "2")
+        cfg = InitConfig.from_env()
+        assert cfg.coordinator_address == "10.0.0.1:29500"
+        assert cfg.num_processes == 4
+        assert cfg.process_id == 2
+
+    def test_from_env_empty(self, monkeypatch):
+        for var in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK"):
+            monkeypatch.delenv(var, raising=False)
+        cfg = InitConfig.from_env()
+        assert cfg.coordinator_address is None
+        assert cfg.num_processes is None
+        assert cfg.process_id is None
+
+    def test_addr_without_port_ignored(self, monkeypatch):
+        monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
+        monkeypatch.delenv("MASTER_PORT", raising=False)
+        cfg = InitConfig.from_env()
+        assert cfg.coordinator_address is None
+
+
+class Test2DMeshCollectives:
+    """Collectives over ONE axis of a 2-D mesh: partial reductions —
+    the sub-communicator pattern (row/column groups)."""
+
+    def _run(self, fn, in_specs, out_specs):
+        mesh = comm.make_mesh((2, 4), ("row", "col"), platform="cpu")
+        mapped = jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            )
+        )
+        return mesh, mapped
+
+    def test_partial_all_reduce_over_col(self):
+        def fn():
+            val = (
+                lax.axis_index("row") * 10 + lax.axis_index("col")
+            ).astype(jnp.float32)
+            return comm.all_reduce(val, axis_name="col").reshape(1, 1)
+
+        mesh, mapped = self._run(fn, (), P("row", "col"))
+        out = np.asarray(mapped())
+        # row r: sum over col of (10r + c) = 40r + 6
+        for r in range(2):
+            np.testing.assert_allclose(out[r], np.full(4, 40 * r + 6))
+
+    def test_ring_over_row_axis(self):
+        from tpu_dist import parallel
+
+        def fn():
+            val = (lax.axis_index("row") + 1).astype(jnp.float32).reshape(1)
+            return parallel.ring_all_reduce(val, "row").reshape(1, 1)
+
+        mesh, mapped = self._run(fn, (), P("row", "col"))
+        np.testing.assert_allclose(np.asarray(mapped()), 3.0)
+
+    def test_shift_over_col_axis(self):
+        def fn():
+            val = lax.axis_index("col").astype(jnp.float32).reshape(1)
+            return comm.shift(val, 1, axis_name="col").reshape(1, 1)
+
+        mesh, mapped = self._run(fn, (), P("row", "col"))
+        out = np.asarray(mapped())  # (2, 4): rows x shifted col indices
+        for r in range(2):
+            np.testing.assert_allclose(out[r], (np.arange(4) - 1) % 4)

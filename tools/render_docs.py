@@ -1,0 +1,64 @@
+"""Docs pipeline — the reference's ``make all`` analog (Makefile:4-6:
+tuto.md → tuto.html/index.html via its external paperify).
+
+Renders ``docs/*.md`` to standalone HTML.  Uses the ``markdown`` package
+when available; otherwise falls back to a readable <pre> wrapper so the
+pipeline works in any environment (this container has no doc toolchain
+guarantees)."""
+
+from __future__ import annotations
+
+import html
+import sys
+from pathlib import Path
+
+TEMPLATE = """<!doctype html>
+<html><head><meta charset="utf-8">
+<title>{title}</title>
+<style>
+body {{ max-width: 52rem; margin: 2rem auto; padding: 0 1rem;
+       font: 16px/1.6 system-ui, sans-serif; color: #222; }}
+pre, code {{ background: #f5f5f5; }}
+pre {{ padding: .8rem; overflow-x: auto; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #ccc; padding: .3rem .6rem; }}
+</style></head><body>
+{body}
+</body></html>
+"""
+
+
+def render(src: Path, dst: Path) -> str:
+    text = src.read_text()
+    try:
+        import markdown
+
+        body = markdown.markdown(
+            text, extensions=["tables", "fenced_code"]
+        )
+        mode = "markdown"
+    except ImportError:
+        body = f"<pre>{html.escape(text)}</pre>"
+        mode = "pre-fallback"
+    title = text.splitlines()[0].lstrip("# ") if text else src.name
+    dst.write_text(TEMPLATE.format(title=html.escape(title), body=body))
+    return mode
+
+
+def main():
+    docs = Path(__file__).parent.parent / "docs"
+    out = docs / "html"
+    out.mkdir(exist_ok=True)
+    for src in sorted(docs.glob("*.md")):
+        dst = out / (src.stem + ".html")
+        mode = render(src, dst)
+        print(f"{src.name} -> {dst.relative_to(docs.parent)} [{mode}]")
+    # the reference copies tuto.html to index.html (Makefile:6)
+    tut = out / "tutorial.html"
+    if tut.exists():
+        (out / "index.html").write_text(tut.read_text())
+        print("tutorial.html -> docs/html/index.html")
+
+
+if __name__ == "__main__":
+    main()
