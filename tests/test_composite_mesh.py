@@ -1,0 +1,121 @@
+"""Composite parallelism: data × sequence parallel LM training on a 2-D
+mesh — batch sharded over 'data', sequence over 'seq', ring attention
+inside, gradient averaging over BOTH axes.  The full-stack configuration
+the framework exists for."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_dist import comm, models
+
+DP, SP = 2, 4
+B, S, V = 4, 16, 32  # global batch, global seq, vocab
+S_LOCAL = S // SP
+B_LOCAL = B // DP
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return models.TransformerLM(vocab=V, dim=16, depth=1, heads=2, max_seq=S)
+
+
+def _mesh():
+    return comm.make_mesh((DP, SP), ("data", "seq"), platform="cpu")
+
+
+def test_dp_sp_loss_and_grads_match_dense(lm):
+    """Loss and gradients computed on the (data × seq) mesh must equal
+    the dense single-device computation."""
+    params, _ = lm.init(jax.random.key(0))
+    tokens = models.synthetic_tokens(B, S, V)
+    mesh = _mesh()
+
+    def dense_loss(params):
+        logits, _ = lm.apply(params, {}, tokens)
+        return models.lm_loss(logits, tokens)
+
+    l_dense, g_dense = jax.value_and_grad(dense_loss)(params)
+
+    def spmd(params, tokens):
+        def loss(params):
+            db = lax.axis_index("data")
+            sb = lax.axis_index("seq")
+            local = lax.dynamic_slice(
+                tokens,
+                (db * B_LOCAL, sb * S_LOCAL),
+                (B_LOCAL, S_LOCAL),
+            )
+            logits = lm.apply_seq_parallel(params, local, "seq")
+            loss_val = models.lm_loss_seq_parallel(logits, local, "seq")
+            # mean over both mesh axes: seq normalization is built into
+            # lm_loss_seq_parallel; data axis is a straight mean
+            return lax.pmean(lax.pmean(loss_val, "seq"), "data")
+
+        l, g = jax.value_and_grad(loss)(params)
+        # replicas agree after pmean of grads over both axes
+        g = jax.tree.map(
+            lambda t: lax.pmean(lax.pmean(t, "seq"), "data"), g
+        )
+        return l, g
+
+    mapped = jax.jit(
+        jax.shard_map(
+            spmd, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    repl = NamedSharding(mesh, P())
+    l_mesh, g_mesh = mapped(
+        jax.device_put(params, repl), jax.device_put(tokens, repl)
+    )
+    np.testing.assert_allclose(float(l_mesh), float(l_dense), rtol=1e-4)
+    for a, b in zip(jax.tree.leaves(g_mesh), jax.tree.leaves(g_dense)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5
+        )
+
+
+def test_dp_sp_training_converges(lm):
+    """A few SGD steps on the composite mesh reduce the dense loss."""
+    params, _ = lm.init(jax.random.key(1))
+    tokens = models.synthetic_tokens(B, S, V)
+    mesh = _mesh()
+
+    def spmd_step(params, tokens):
+        def loss(params):
+            db = lax.axis_index("data")
+            sb = lax.axis_index("seq")
+            local = lax.dynamic_slice(
+                tokens, (db * B_LOCAL, sb * S_LOCAL), (B_LOCAL, S_LOCAL)
+            )
+            logits = lm.apply_seq_parallel(params, local, "seq")
+            return lax.pmean(
+                lax.pmean(
+                    models.lm_loss_seq_parallel(logits, local, "seq"), "seq"
+                ),
+                "data",
+            )
+
+        l, g = jax.value_and_grad(loss)(params)
+        g = jax.tree.map(lambda t: lax.pmean(lax.pmean(t, "seq"), "data"), g)
+        params = jax.tree.map(lambda p, gg: p - 0.3 * gg, params, g)
+        return params, l
+
+    mapped = jax.jit(
+        jax.shard_map(
+            spmd_step, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    repl = NamedSharding(mesh, P())
+    p = jax.device_put(params, repl)
+    t = jax.device_put(tokens, repl)
+    losses = []
+    for _ in range(10):
+        p, l = mapped(p, t)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
