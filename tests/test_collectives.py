@@ -172,6 +172,20 @@ class TestPointToPoint:
         np.testing.assert_allclose(out[1:7], 0.0)
 
 
+def test_spmd_shard_argnums():
+    """shard_argnums splits an arg over ranks instead of replicating —
+    rank r sees its own slice."""
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    def fn(local):
+        # each rank holds (1, 2); sum it and add rank
+        return local.sum() + comm.rank()
+
+    out = comm.spmd(fn, x, world=8, platform="cpu", shard_argnums=(0,))
+    expect = np.asarray(x).reshape(8, 2).sum(1) + np.arange(8)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
 def test_rank_world_size():
     def fn():
         return comm.rank(), jnp.zeros(()) + comm.world_size()

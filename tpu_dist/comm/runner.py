@@ -28,14 +28,19 @@ def spmd(
     axis_name: str = DEFAULT_AXIS,
     platform: str | None = None,
     jit: bool = True,
+    shard_argnums: tuple[int, ...] = (),
 ) -> Any:
     """Run ``fn(*args)`` as one program instance per mesh device.
 
     ``fn`` is written rank-style, using `tpu_dist.comm` collectives with
-    ``axis_name``; ``args`` are replicated to every rank (like each forked
-    process constructing the same inputs).  Returns ``fn``'s result pytree
-    with a leading ``(world,)`` axis stacking each rank's value — the
-    analog of collecting every process's prints.
+    ``axis_name``.  By default ``args`` are replicated to every rank (like
+    each forked process constructing the same inputs); argument positions
+    in ``shard_argnums`` are instead SPLIT over their leading axis — rank
+    r's instance receives its own slice (leading dim must be divisible by
+    the world size), the device-mesh analog of handing each process its
+    partition.  Returns ``fn``'s result pytree with a leading ``(world,)``
+    axis stacking each rank's value — the analog of collecting every
+    process's prints.
     """
     if mesh is None:
         mesh = (
@@ -43,20 +48,35 @@ def spmd(
             if world is not None
             else world_mesh(axis_name, platform=platform)
         )
+    n = int(mesh.shape[axis_name])
 
     def per_rank(*a):
         out = fn(*a)
         return jax.tree.map(lambda y: jnp.expand_dims(jnp.asarray(y), 0), out)
 
+    in_specs = tuple(
+        P(axis_name) if i in shard_argnums else P() for i in range(len(args))
+    )
     mapped = jax.shard_map(
-        per_rank, mesh=mesh, in_specs=P(), out_specs=P(axis_name), check_vma=False
+        per_rank, mesh=mesh, in_specs=in_specs, out_specs=P(axis_name),
+        check_vma=False,
     )
     if jit:
         mapped = jax.jit(mapped)
-    # Replicate inputs onto the mesh so host arrays land on the right
-    # platform (tests drive a CPU mesh while the default backend is TPU).
+    # Place inputs onto the mesh so host arrays land on the right platform
+    # (tests drive a CPU mesh while the default backend is TPU).
     repl = NamedSharding(mesh, P())
-    args = jax.tree.map(
-        lambda a: jax.device_put(jnp.asarray(a), repl), tuple(args)
-    )
-    return mapped(*args)
+    sharded = NamedSharding(mesh, P(axis_name))
+    placed = []
+    for i, a in enumerate(args):
+        if i in shard_argnums:
+            placed.append(
+                jax.tree.map(
+                    lambda x: jax.device_put(jnp.asarray(x), sharded), a
+                )
+            )
+        else:
+            placed.append(
+                jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), repl), a)
+            )
+    return mapped(*placed)
