@@ -6,7 +6,8 @@ WORLD ?= 8
 PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
-.PHONY: test ptp gather allreduce train bench runtime
+.PHONY: test ptp gather allreduce train bench runtime train-image \
+        scaling multiproc longcontext train-lm docs
 
 test:
 	$(PY) -m pytest tests/ -x -q

@@ -49,6 +49,14 @@ def spmd(
             else world_mesh(axis_name, platform=platform)
         )
     n = int(mesh.shape[axis_name])
+    for i in shard_argnums:
+        for leaf in jax.tree.leaves(args[i]):
+            dim = jnp.asarray(leaf).shape[0] if jnp.asarray(leaf).ndim else 0
+            if dim % n:
+                raise ValueError(
+                    f"shard_argnums arg {i}: leading dim {dim} not "
+                    f"divisible by world size {n}"
+                )
 
     def per_rank(*a):
         out = fn(*a)

@@ -32,11 +32,23 @@ def dot_product_attention(
     tolerance (differentiable either way)."""
     import os
 
-    if os.environ.get("TPU_DIST_FLASH", "0") == "1" and q.shape[-2] >= 128:
-        from tpu_dist.ops.flash_attention import flash_attention
+    if os.environ.get("TPU_DIST_FLASH", "0") == "1":
+        S = q.shape[-2]
+        bq = bk = min(256, S)
+        eligible = (
+            q.shape == k.shape == v.shape  # self-attention lengths only
+            and S >= 128
+            and S % bq == 0
+        )
+        if eligible:
+            from tpu_dist.ops.flash_attention import flash_attention
 
-        interp = jax.default_backend() != "tpu"
-        return flash_attention(q, k, v, causal=causal, interpret=interp)
+            interp = jax.default_backend() != "tpu"
+            return flash_attention(
+                q, k, v, causal=causal, bq=bq, bk=bk, interpret=interp
+            )
+        # fall through to the dense path for shapes the kernel can't take
+        # (cross-attention, indivisible block sizes, short sequences)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("...hqd,...hkd->...hqk", q * scale, k)
     if causal:

@@ -7,10 +7,11 @@ of over ring hops between chips — the (S, S) score matrix is never
 materialized in HBM.  Grid: one program per (batch·head, query-block);
 each program scans key/value blocks with ``lax.fori_loop``.
 
-Interpret-mode tested against `tpu_dist.nn.dot_product_attention` on CPU;
-compiled on TPU.  Forward-only (wrap in `jax.checkpoint` + autodiff via
-recompute, or use the XLA path for training; a custom bwd kernel is a
-round-2 item — ROADMAP.md).
+Interpret-mode tested against `tpu_dist.nn.dot_product_attention` on CPU
+(values and gradients); compiled on TPU.  Differentiable: the forward
+kernel emits per-row LSE, and a custom VJP runs the standard flash
+backward recurrence scanned over key blocks in plain XLA (peak
+intermediate (S, bk)); a fused backward *kernel* remains a ROADMAP item.
 """
 
 from __future__ import annotations

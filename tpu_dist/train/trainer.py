@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from tpu_dist import nn, parallel
-from tpu_dist.data.loader import DistributedLoader
+from tpu_dist.data.loader import DistributedLoader, prefetch_to_mesh
 from tpu_dist.train.optim import Optimizer, sgd
 
 
@@ -188,8 +188,6 @@ class Trainer:
         for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
             t0 = time.perf_counter()
             total_loss, num_batches = 0.0, 0
-            from tpu_dist.data.loader import prefetch_to_mesh
-
             with metrics_mod.trace(trace_dir if epoch == start_epoch else None):
                 batches = prefetch_to_mesh(
                     loader.epoch(epoch), self.mesh,
