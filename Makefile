@@ -47,3 +47,7 @@ train-lm:
 
 docs:
 	$(PY) tools/render_docs.py
+
+# All four reference-parity demos in sequence (the reference's scripts,
+# TPU-style), on the simulated mesh by default.
+demos: ptp gather allreduce train

@@ -23,6 +23,8 @@ def main():
         default_world=None,
         epochs=(int, 10, "training epochs (reference: 10)"),
         samples=(int, 0, "cap dataset size (0 = full 60k)"),
+        trace=(str, "", "jax.profiler trace dir (perfetto) for epoch 0"),
+        ckpt=(str, "", "checkpoint dir; resumes from the newest epoch"),
     )
     from tpu_dist import comm, data, models, train
 
@@ -39,7 +41,24 @@ def main():
         mesh,
         train.TrainConfig(epochs=args.epochs),
     )
-    trainer.fit(ds)
+    start_epoch = 0
+    if args.ckpt:
+        import glob
+        import os as _os
+
+        ckpts = sorted(
+            glob.glob(f"{args.ckpt}/ckpt_*.npz"),
+            key=lambda p: int(p.rsplit("_", 1)[1].split(".")[0]),
+        )
+        if ckpts:
+            start_epoch = trainer.restore(ckpts[-1])
+            print(f"resumed from {ckpts[-1]} at epoch {start_epoch}")
+    trainer.fit(
+        ds,
+        start_epoch=start_epoch,
+        checkpoint_dir=args.ckpt or None,
+        trace_dir=args.trace or None,
+    )
     test = data.load_mnist("test", synthetic_size=min(10000, len(ds)) if ds.synthetic else None)
     print(f"Test accuracy: {trainer.evaluate(test):.4f}")
 

@@ -2,6 +2,7 @@
 requirement (BASELINE.md): the hand-rolled ring (allreduce.py:8-34, done
 *correctly* per SURVEY.md §2c.1) must agree with the built-in collective."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -97,6 +98,37 @@ def test_ring_dtypes(dtype):
     )
     np.testing.assert_allclose(
         np.asarray(chunked, np.float64), np.asarray(psum, np.float64), rtol=1e-2
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_ring_fuzz_random_shapes_and_worlds(seed):
+    """Seeded fuzz: random shape, world size, and payload — ring must
+    track psum everywhere."""
+    import random as pyrandom
+
+    rng = pyrandom.Random(seed)
+    world = rng.choice([2, 3, 4, 5, 6, 7, 8])
+    ndim = rng.randint(1, 3)
+    shape = tuple(rng.randint(1, 9) for _ in range(ndim))
+
+    def fn():
+        x = (
+            jax.random.normal(jax.random.key(seed), shape)
+            * (comm.rank() + 1.0)
+        )
+        return (
+            parallel.ring_all_reduce(x),
+            parallel.ring_all_reduce_chunked(x),
+            comm.all_reduce(x),
+        )
+
+    naive, chunked, psum = run(fn, world=world)
+    np.testing.assert_allclose(
+        np.asarray(naive), np.asarray(psum), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(chunked), np.asarray(psum), rtol=1e-4, atol=1e-5
     )
 
 
