@@ -71,6 +71,27 @@ class TestGroups:
         np.testing.assert_allclose(out[:2], [2.0, 2.0])
         np.testing.assert_allclose(out[2:], np.ones(N - 2))
 
+    def test_group_broadcast(self):
+        g = comm.new_group([1, 3, 5])
+
+        def fn():
+            x = comm.rank().astype(jnp.float32) * 10.0
+            return comm.broadcast(x, src=3, group=g)
+
+        out = np.asarray(run(fn))
+        expect = 10.0 * np.arange(N)
+        expect[[1, 3, 5]] = 30.0
+        np.testing.assert_allclose(out, expect)
+
+    def test_group_broadcast_bad_src_raises(self):
+        g = comm.new_group([1, 3])
+
+        def fn():
+            return comm.broadcast(jnp.ones(()), src=0, group=g)
+
+        with pytest.raises(ValueError, match="not in group"):
+            run(fn)
+
     def test_odd_sized_group_max(self):
         g = comm.new_group([1, 4, 6])
 

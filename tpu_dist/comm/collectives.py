@@ -178,14 +178,25 @@ def reduce(
 
 
 def broadcast(
-    x: jax.Array, src: int, axis_name: str = DEFAULT_AXIS
+    x: jax.Array,
+    src: int,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    group: Group | None = None,
 ) -> jax.Array:
     """``dist.broadcast(tensor, src)`` (tuto.md:195): all ranks end with
     src's value.  Implemented as a masked AllReduce (multicast is not a
     permutation, so ppermute can't express it; XLA fuses the mask).
+    With ``group``, only members receive src's value (src must be a
+    member); non-members keep their input, matching torch semantics.
     """
     contrib = jnp.where(lax.axis_index(axis_name) == src, x, jnp.zeros_like(x))
-    return lax.psum(contrib, axis_name)
+    value = lax.psum(contrib, axis_name)
+    if group is None:
+        return value
+    if src not in group.ranks:
+        raise ValueError(f"broadcast src {src} not in group {group.ranks}")
+    return jnp.where(group.is_member(axis_name), value, x)
 
 
 def all_gather(
