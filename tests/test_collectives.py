@@ -92,6 +92,22 @@ class TestGroups:
         with pytest.raises(ValueError, match="not in group"):
             run(fn)
 
+    def test_group_gather(self):
+        g = comm.new_group([0, 2])
+
+        def fn():
+            return comm.gather(
+                (comm.rank() + 1.0).reshape(1), dst=2, group=g
+            )
+
+        out = np.asarray(run(fn))  # (N, N, 1)
+        expect_row = np.zeros(N)
+        expect_row[[0, 2]] = [1.0, 3.0]
+        np.testing.assert_allclose(out[2, :, 0], expect_row)
+        for r in range(N):
+            if r != 2:
+                np.testing.assert_allclose(out[r], 0.0)
+
     def test_odd_sized_group_max(self):
         g = comm.new_group([1, 4, 6])
 

@@ -69,6 +69,53 @@ class TestRendezvous:
             runtime.rendezvous("127.0.0.1", port, 2, 0, timeout_ms=500)
 
 
+class TestNativeIdxReader:
+    def _write_pair(self, tmp_path):
+        import struct
+
+        import numpy as np
+
+        imgs = np.arange(3 * 28 * 28, dtype=np.uint8).reshape(3, 28, 28)
+        labels = np.array([4, 2, 9], np.uint8)
+        ip = tmp_path / "imgs"
+        lp = tmp_path / "labels"
+        ip.write_bytes(struct.pack(">IIII", 2051, 3, 28, 28) + imgs.tobytes())
+        lp.write_bytes(struct.pack(">II", 2049, 3) + labels.tobytes())
+        return ip, lp, imgs, labels
+
+    def test_reads_images_and_labels(self, tmp_path):
+        import numpy as np
+
+        ip, lp, imgs, labels = self._write_pair(tmp_path)
+        got_i = runtime.read_idx(ip)
+        got_l = runtime.read_idx(lp)
+        np.testing.assert_array_equal(got_i, imgs)
+        np.testing.assert_array_equal(got_l, labels)
+
+    def test_matches_numpy_parser(self, tmp_path):
+        import numpy as np
+
+        from tpu_dist import data
+
+        ip, lp, imgs, labels = self._write_pair(tmp_path)
+        np.testing.assert_array_equal(data.load_idx_images(ip)[..., 0], imgs)
+        np.testing.assert_array_equal(data.load_idx_labels(lp), labels)
+
+    def test_bad_magic_raises(self, tmp_path):
+        p = tmp_path / "junk"
+        p.write_bytes(b"\x00\x00\x00\x99" + b"\x00" * 16)
+        with pytest.raises(ValueError, match="bad IDX magic"):
+            runtime.read_idx(p)
+
+    def test_truncated_raises(self, tmp_path):
+        import struct
+
+        p = tmp_path / "trunc"
+        p.write_bytes(struct.pack(">IIII", 2051, 100, 28, 28) + b"\x00" * 10)
+        with pytest.raises(ValueError, match="truncated"):
+            runtime.read_idx(p)
+
+
 @pytest.mark.slow
 def test_multiprocess_psum_end_to_end():
     """True multi-process collectives: fork-join launcher + native

@@ -209,14 +209,27 @@ def all_gather(
 
 
 def gather(
-    x: jax.Array, dst: int, axis_name: str = DEFAULT_AXIS
+    x: jax.Array,
+    dst: int,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    group: Group | None = None,
 ) -> jax.Array:
     """``dist.gather(tensor, dst, gather_list)`` (tuto.md:198; demoed at
     ptp.py:21-28): dst receives the stack of all contributions; other ranks
     receive zeros (torch gives them nothing — SPMD outputs are uniform, so
-    "nothing" is zeros)."""
+    "nothing" is zeros).  With ``group``, non-member rows of dst's stack
+    are zeroed and only the (member) dst receives anything."""
     stacked = lax.all_gather(x, axis_name, axis=0)
-    return jnp.where(lax.axis_index(axis_name) == dst, stacked, jnp.zeros_like(stacked))
+    if group is not None:
+        if dst not in group.ranks:
+            raise ValueError(f"gather dst {dst} not in group {group.ranks}")
+        n = lax.axis_size(axis_name)
+        mask = group.mask(n).reshape((n,) + (1,) * x.ndim)
+        stacked = jnp.where(mask, stacked, jnp.zeros_like(stacked))
+    return jnp.where(
+        lax.axis_index(axis_name) == dst, stacked, jnp.zeros_like(stacked)
+    )
 
 
 def scatter(

@@ -58,7 +58,24 @@ def _open_maybe_gz(path: Path):
     return open(path, "rb")
 
 
+def _native_idx(path: Path):
+    """Try the native mmap reader (tpu_dist/runtime/idx_reader.cc);
+    returns None to fall back to the numpy parser (gz files, build
+    failures)."""
+    if path.suffix == ".gz":
+        return None
+    try:
+        from tpu_dist import runtime
+
+        return runtime.read_idx(path)
+    except Exception:
+        return None
+
+
 def load_idx_images(path: Path) -> np.ndarray:
+    native = _native_idx(path)
+    if native is not None and native.ndim == 3:
+        return native[..., None]
     with _open_maybe_gz(path) as f:
         magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
         if magic != 2051:
@@ -68,6 +85,9 @@ def load_idx_images(path: Path) -> np.ndarray:
 
 
 def load_idx_labels(path: Path) -> np.ndarray:
+    native = _native_idx(path)
+    if native is not None and native.ndim == 1:
+        return native.astype(np.int32)
     with _open_maybe_gz(path) as f:
         magic, n = struct.unpack(">II", f.read(8))
         if magic != 2049:

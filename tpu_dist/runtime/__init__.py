@@ -10,6 +10,9 @@ API:
     → ``(my_rank, {rank: payload})`` — master/worker bootstrap with rank
     assignment and a startup barrier.
   - `free_port()` → an available loopback TCP port.
+  - `read_idx(path)` → numpy array via the native mmap reader
+    (`idx_reader.cc`) — the data-loading native fast path; the pure-numpy
+    parser in `tpu_dist.data.mnist` is the fallback.
 """
 
 from __future__ import annotations
@@ -58,6 +61,57 @@ def _load():
         lib.td_last_error.restype = ctypes.c_char_p
         _lib = lib
         return lib
+
+
+_idx_lib = None
+
+
+def _load_idx():
+    global _idx_lib
+    with _lock:
+        if _idx_lib is not None:
+            return _idx_lib
+        path = _HERE / "build" / "libidxreader.so"
+        if not path.exists():
+            _build()
+        lib = ctypes.CDLL(str(path))
+        lib.td_idx_open.restype = ctypes.c_void_p
+        lib.td_idx_open.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+        ]
+        lib.td_idx_close.argtypes = [ctypes.c_void_p]
+        lib.td_idx_last_error.restype = ctypes.c_char_p
+        _idx_lib = lib
+        return lib
+
+
+def read_idx(path):
+    """Parse an IDX file via the native mmap reader.
+
+    Returns a numpy uint8 array: ``(n, rows, cols)`` for image files,
+    ``(n,)`` for label files.  The data is copied out of the mapping
+    (so the handle can be closed immediately); for the 60k MNIST train
+    set this is one 45 MB memcpy from page cache — no Python-level
+    byte shuffling.
+    """
+    import numpy as np
+
+    lib = _load_idx()
+    dims = (ctypes.c_int64 * 3)()
+    data = ctypes.POINTER(ctypes.c_ubyte)()
+    handle = lib.td_idx_open(str(path).encode(), dims, ctypes.byref(data))
+    if not handle:
+        err = lib.td_idx_last_error().decode() or "unknown idx error"
+        raise ValueError(f"native IDX read failed: {err}")
+    try:
+        n, rows, cols = dims[0], dims[1], dims[2]
+        count = n * (rows * cols if rows else 1)
+        arr = np.ctypeslib.as_array(data, shape=(count,)).copy()
+    finally:
+        lib.td_idx_close(handle)
+    return arr.reshape((n, rows, cols) if rows else (n,))
 
 
 def free_port() -> int:

@@ -1,0 +1,108 @@
+// Native IDX-format reader — the data-loading half of the runtime.
+//
+// The reference's input pipeline rides torch's native DataLoader machinery
+// (C++ worker pool feeding the Python loop, train_dist.py:89); tpu_dist's
+// Python path is already vectorized numpy, and this component provides the
+// native fast path: mmap the IDX file (zero-copy page-cache reads), parse
+// the header, and hand Python a pointer it wraps as a numpy array without
+// a userspace copy.  ctypes-bound like rendezvous.cc (no pybind11).
+//
+// IDX format (as written by the original MNIST distribution):
+//   u32 magic (0x801 labels / 0x803 images, big-endian)
+//   u32 count [, u32 rows, u32 cols for images]
+//   payload bytes
+//
+// Build: make -C tpu_dist/runtime
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+thread_local char g_err[256] = {0};
+
+uint32_t be32(const unsigned char* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+}  // namespace
+
+extern "C" {
+
+const char* td_idx_last_error() { return g_err; }
+
+// Maps the file and parses the header.
+// On success returns a handle pointer and fills:
+//   dims_out[0..2] = count, rows, cols (rows/cols 0 for labels)
+//   data_out = pointer to payload (valid until td_idx_close)
+// Returns nullptr on failure (see td_idx_last_error).
+void* td_idx_open(const char* path, int64_t* dims_out,
+                  const unsigned char** data_out) {
+  g_err[0] = 0;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) {
+    snprintf(g_err, sizeof(g_err), "open %s: %s", path, strerror(errno));
+    return nullptr;
+  }
+  struct stat st{};
+  if (fstat(fd, &st) < 0 || st.st_size < 8) {
+    snprintf(g_err, sizeof(g_err), "stat %s: bad size", path);
+    close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, static_cast<size_t>(st.st_size), PROT_READ,
+                   MAP_PRIVATE, fd, 0);
+  close(fd);  // mapping keeps the file alive
+  if (map == MAP_FAILED) {
+    snprintf(g_err, sizeof(g_err), "mmap %s: %s", path, strerror(errno));
+    return nullptr;
+  }
+  const unsigned char* p = static_cast<const unsigned char*>(map);
+  uint32_t magic = be32(p);
+  int64_t count = be32(p + 4), rows = 0, cols = 0;
+  size_t header = 8, item = 1;
+  if (magic == 0x803) {  // images
+    if (st.st_size < 16) {
+      snprintf(g_err, sizeof(g_err), "%s: truncated image header", path);
+      munmap(map, static_cast<size_t>(st.st_size));
+      return nullptr;
+    }
+    rows = be32(p + 8);
+    cols = be32(p + 12);
+    header = 16;
+    item = static_cast<size_t>(rows * cols);
+  } else if (magic != 0x801) {
+    snprintf(g_err, sizeof(g_err), "%s: bad IDX magic 0x%x", path, magic);
+    munmap(map, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  if (static_cast<size_t>(st.st_size) <
+      header + item * static_cast<size_t>(count)) {
+    snprintf(g_err, sizeof(g_err), "%s: truncated payload", path);
+    munmap(map, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  dims_out[0] = count;
+  dims_out[1] = rows;
+  dims_out[2] = cols;
+  *data_out = p + header;
+  // Handle = the mapping base + size packed into a small struct.
+  auto* h = new int64_t[2];
+  h[0] = reinterpret_cast<int64_t>(map);
+  h[1] = st.st_size;
+  return h;
+}
+
+void td_idx_close(void* handle) {
+  if (!handle) return;
+  auto* h = static_cast<int64_t*>(handle);
+  munmap(reinterpret_cast<void*>(h[0]), static_cast<size_t>(h[1]));
+  delete[] h;
+}
+
+}  // extern "C"
