@@ -157,6 +157,18 @@ class TestDataMovement:
         # every rank r receives chunk r of src(=2)'s array: 3*r
         np.testing.assert_allclose(out, 3.0 * np.arange(N))
 
+    def test_group_scatter(self):
+        g = comm.new_group([1, 4])
+
+        def fn():
+            xs = jnp.array([100.0, 200.0])  # one chunk per member
+            return comm.scatter(xs, src=1, group=g)
+
+        out = np.asarray(run(fn))
+        expect = np.zeros(N)
+        expect[1], expect[4] = 100.0, 200.0
+        np.testing.assert_allclose(out, expect)
+
     def test_reduce_root_only(self):
         def fn():
             return comm.reduce(jnp.ones(()), dst=5)

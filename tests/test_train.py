@@ -62,6 +62,15 @@ def test_evaluate_runs(mesh, dataset):
     assert 0.0 <= acc <= 1.0
 
 
+def test_fit_with_eval_dataset(mesh, dataset):
+    t = _make_trainer(mesh, epochs=1)
+    hist = t.fit(
+        dataset, eval_dataset=data.load_mnist("test", synthetic_size=500)
+    )
+    assert hist[0].eval_accuracy is not None
+    assert 0.0 <= hist[0].eval_accuracy <= 1.0
+
+
 def test_checkpoint_roundtrip(tmp_path, mesh):
     t = _make_trainer(mesh, epochs=1)
     ckpt = tmp_path / "state.npz"

@@ -233,23 +233,39 @@ def gather(
 
 
 def scatter(
-    xs: jax.Array, src: int, axis_name: str = DEFAULT_AXIS
+    xs: jax.Array,
+    src: int,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    group: Group | None = None,
 ) -> jax.Array:
     """``dist.scatter(tensor, src, scatter_list)`` (tuto.md:197): src's i-th
     chunk (leading axis) lands on rank i.  Only src's ``xs`` matters; it is
     broadcast (chips share ICI bandwidth; XLA may optimize to a true
-    scatter) and each rank slices its own chunk."""
+    scatter) and each rank slices its own chunk.  With ``group``, chunk i
+    goes to the i-th member (src must be a member; non-members keep
+    zeros); ``xs`` then carries ``len(group.ranks)`` chunks."""
     n = lax.axis_size(axis_name)
-    if xs.shape[0] != n:
+    expected = len(group.ranks) if group is not None else n
+    if xs.shape[0] != expected:
         raise ValueError(
-            f"scatter needs one leading-axis chunk per rank: got "
-            f"xs.shape[0]={xs.shape[0]} for world size {n} (torch raises on "
+            f"scatter needs one leading-axis chunk per participant: got "
+            f"xs.shape[0]={xs.shape[0]} for {expected} (torch raises on "
             f"mismatched scatter_list length too)"
         )
+    if group is not None and src not in group.ranks:
+        raise ValueError(f"scatter src {src} not in group {group.ranks}")
     from_src = broadcast(xs, src, axis_name)
-    return lax.dynamic_index_in_dim(
-        from_src, lax.axis_index(axis_name), axis=0, keepdims=False
-    )
+    if group is None:
+        return lax.dynamic_index_in_dim(
+            from_src, lax.axis_index(axis_name), axis=0, keepdims=False
+        )
+    # member index of this rank within the (sorted) group, 0 for others
+    r = lax.axis_index(axis_name)
+    ranks = jnp.array(group.ranks)
+    member_idx = jnp.argmax(ranks == r)
+    chunk = lax.dynamic_index_in_dim(from_src, member_idx, 0, keepdims=False)
+    return jnp.where(group.is_member(axis_name), chunk, jnp.zeros_like(chunk))
 
 
 # ---------------------------------------------------------------------------

@@ -57,6 +57,7 @@ class EpochStats:
     mean_loss: float
     seconds: float
     samples_per_sec: float
+    eval_accuracy: float | None = None
 
 
 class Trainer:
@@ -162,13 +163,16 @@ class Trainer:
         start_epoch: int = 0,
         checkpoint_dir: str | None = None,
         trace_dir: str | None = None,
+        eval_dataset=None,
     ) -> list[EpochStats]:
         """Run the training loop.
 
         ``start_epoch`` resumes mid-schedule (pair with `restore`);
         ``checkpoint_dir`` writes ``ckpt_<epoch>.npz`` after each epoch;
         ``trace_dir`` captures a jax.profiler trace of epoch
-        ``start_epoch`` (perfetto-viewable — SURVEY.md §5 tracing).
+        ``start_epoch`` (perfetto-viewable — SURVEY.md §5 tracing);
+        ``eval_dataset`` reports held-out accuracy after each epoch
+        (an extension — the reference prints train loss only).
         """
         from tpu_dist.train import metrics as metrics_mod
 
@@ -211,11 +215,15 @@ class Trainer:
             sps = num_batches * cfg.global_batch / dt
             # train_dist.py:125-127 observable — one line stands for all
             # (identical) ranks.
+            acc = None
+            if eval_dataset is not None:
+                acc = self.evaluate(eval_dataset)
             cfg.log(
                 f"Rank all (x{self.world} identical replicas), epoch {epoch}: "
                 f"{mean_loss:.4f}  [{sps:,.0f} samples/s]"
+                + (f"  eval acc {acc:.4f}" if acc is not None else "")
             )
-            history.append(EpochStats(epoch, mean_loss, dt, sps))
+            history.append(EpochStats(epoch, mean_loss, dt, sps, acc))
             if checkpoint_dir is not None:
                 self.save(
                     f"{checkpoint_dir}/ckpt_{epoch}.npz", epoch=epoch + 1
