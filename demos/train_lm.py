@@ -30,15 +30,10 @@ def main():
     mesh = comm.make_mesh(world, ("data",), platform=args.platform)
     lm = models.TransformerLM(vocab=64, dim=64, depth=2, heads=4, max_seq=args.seq)
     params, _ = lm.init(jax.random.key(1234))
-    # AdamW under a cosine schedule: adamw's state already counts steps,
-    # so the scheduled lr is just evaluated inside update (traced, fused).
-    sched = train.schedule.cosine(3e-3, args.steps, warmup_steps=args.steps // 10)
-    base = train.adamw(1.0)
-
-    def update(p, g, s):
-        return train.adamw(sched(s["step"])).update(p, g, s)
-
-    opt = train.Optimizer(init=base.init, update=update)
+    # AdamW under a cosine schedule (lr evaluated in the compiled update).
+    opt = train.adamw(
+        train.schedule.cosine(3e-3, args.steps, warmup_steps=args.steps // 10)
+    )
 
     compute = "bfloat16" if args.bf16 else None
 
@@ -57,7 +52,7 @@ def main():
     step = parallel.make_stateful_train_step(loss_fn, opt, mesh, donate=False)
     p = parallel.replicate(params, mesh)
     ms = parallel.replicate({}, mesh)
-    os_ = parallel.replicate(base.init(params), mesh)
+    os_ = parallel.replicate(opt.init(params), mesh)
     tokens = models.synthetic_tokens(args.batch, args.seq, 64)
     batch = parallel.shard_batch((tokens,), mesh)
 

@@ -52,6 +52,21 @@ def test_sgd_with_schedule_steps_lr():
     assert int(s["step"]) == 2
 
 
+def test_adamw_with_schedule():
+    """adamw under step_decay: the first update uses lr=1, the second
+    lr=0.1 (visible in step magnitudes)."""
+    opt = train.adamw(schedule.step_decay(1.0, gamma=0.1, every=1))
+    p = {"w": jnp.array([0.0])}
+    s = opt.init(p)
+    g = {"w": jnp.array([1.0])}
+    p1, s = opt.update(p, g, s)
+    step1 = float(p["w"][0] - p1["w"][0])
+    p2, s = opt.update(p1, g, s)
+    step2 = float(p1["w"][0] - p2["w"][0])
+    assert step1 == pytest.approx(10 * step2, rel=1e-4), (step1, step2)
+    assert int(s["step"]) == 2
+
+
 def test_sgd_schedule_with_momentum_jits():
     opt = train.sgd(schedule.cosine(0.1, 100, warmup_steps=5), momentum=0.9)
     p = {"w": jnp.ones(4)}

@@ -73,12 +73,16 @@ def sgd(lr, momentum: float = 0.0) -> Optimizer:
 
 
 def adamw(
-    lr: float,
+    lr,
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
 ) -> Optimizer:
+    """AdamW.  ``lr`` may be a float or a schedule ``f(step) -> lr``
+    (the state's step counter drives it, matching `sgd`)."""
+    lr_fn = lr if callable(lr) else (lambda _step: lr)
+
     def init(params):
         return {
             "step": jnp.zeros((), jnp.int32),
@@ -88,6 +92,7 @@ def adamw(
 
     def update(params, grads, state):
         step = state["step"] + 1
+        cur_lr = lr_fn(state["step"])
         m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
         v = jax.tree.map(
             lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g), state["v"], grads
@@ -98,7 +103,7 @@ def adamw(
         def upd(p, m_, v_):
             mh = m_ / bc1
             vh = v_ / bc2
-            return p - lr * (mh / (jnp.sqrt(vh) + eps) + weight_decay * p)
+            return p - cur_lr * (mh / (jnp.sqrt(vh) + eps) + weight_decay * p)
 
         new_params = jax.tree.map(upd, params, m, v)
         return new_params, {"step": step, "m": m, "v": v}
