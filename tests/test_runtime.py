@@ -115,6 +115,29 @@ class TestNativeIdxReader:
         with pytest.raises(ValueError, match="truncated"):
             runtime.read_idx(p)
 
+    def test_zero_dims_rejected(self, tmp_path):
+        """Crafted rows=cols=0 header must not let Python read past the
+        mapping (was a SIGBUS)."""
+        import struct
+
+        p = tmp_path / "zero"
+        p.write_bytes(struct.pack(">IIII", 2051, 1_000_000, 0, 0))
+        with pytest.raises(ValueError, match="zero image dimensions"):
+            runtime.read_idx(p)
+
+    def test_overflow_header_rejected(self, tmp_path):
+        """count*rows*cols chosen to wrap 64-bit math must be caught by
+        the division-form bound, not crash."""
+        import struct
+
+        p = tmp_path / "wrap"
+        p.write_bytes(
+            struct.pack(">IIII", 2051, 0xFFFFFFFF, 0xFFFFFFFF, 0xFFFFFFFF)
+            + b"\x00" * 64
+        )
+        with pytest.raises(ValueError, match="truncated"):
+            runtime.read_idx(p)
+
 
 @pytest.mark.slow
 def test_multiprocess_psum_end_to_end():

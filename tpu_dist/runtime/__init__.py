@@ -99,16 +99,18 @@ def read_idx(path):
     import numpy as np
 
     lib = _load_idx()
-    dims = (ctypes.c_int64 * 3)()
+    dims = (ctypes.c_int64 * 4)()
     data = ctypes.POINTER(ctypes.c_ubyte)()
     handle = lib.td_idx_open(str(path).encode(), dims, ctypes.byref(data))
     if not handle:
         err = lib.td_idx_last_error().decode() or "unknown idx error"
         raise ValueError(f"native IDX read failed: {err}")
     try:
-        n, rows, cols = dims[0], dims[1], dims[2]
-        count = n * (rows * cols if rows else 1)
-        arr = np.ctypeslib.as_array(data, shape=(count,)).copy()
+        n, rows, cols, payload = dims[0], dims[1], dims[2], dims[3]
+        # Read exactly the byte count C++ validated against the mapping —
+        # never re-derive it here (an undersized read bound is the only
+        # thing standing between a crafted header and a SIGBUS).
+        arr = np.ctypeslib.as_array(data, shape=(payload,)).copy()
     finally:
         lib.td_idx_close(handle)
     return arr.reshape((n, rows, cols) if rows else (n,))

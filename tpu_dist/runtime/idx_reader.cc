@@ -39,6 +39,8 @@ const char* td_idx_last_error() { return g_err; }
 // Maps the file and parses the header.
 // On success returns a handle pointer and fills:
 //   dims_out[0..2] = count, rows, cols (rows/cols 0 for labels)
+//   dims_out[3]    = validated payload size in bytes (what Python may
+//                    safely read — never re-derive it host-side)
 //   data_out = pointer to payload (valid until td_idx_close)
 // Returns nullptr on failure (see td_idx_last_error).
 void* td_idx_open(const char* path, int64_t* dims_out,
@@ -64,8 +66,13 @@ void* td_idx_open(const char* path, int64_t* dims_out,
   }
   const unsigned char* p = static_cast<const unsigned char*>(map);
   uint32_t magic = be32(p);
-  int64_t count = be32(p + 4), rows = 0, cols = 0;
-  size_t header = 8, item = 1;
+  // Unsigned 64-bit size math: u32 inputs make every product below at
+  // most 2^96, so check step-by-step against the real file size instead
+  // of trusting any multiplication (a crafted header must not be able to
+  // wrap the bound — Python reads exactly payload_bytes, and an
+  // undersized mapping means SIGBUS, not an exception).
+  uint64_t count = be32(p + 4), rows = 0, cols = 0;
+  uint64_t header = 8, item = 1;
   if (magic == 0x803) {  // images
     if (st.st_size < 16) {
       snprintf(g_err, sizeof(g_err), "%s: truncated image header", path);
@@ -75,21 +82,29 @@ void* td_idx_open(const char* path, int64_t* dims_out,
     rows = be32(p + 8);
     cols = be32(p + 12);
     header = 16;
-    item = static_cast<size_t>(rows * cols);
+    if (rows == 0 || cols == 0) {
+      snprintf(g_err, sizeof(g_err), "%s: zero image dimensions", path);
+      munmap(map, static_cast<size_t>(st.st_size));
+      return nullptr;
+    }
+    item = rows * cols;  // <= 2^64 / safe: both factors < 2^32
   } else if (magic != 0x801) {
     snprintf(g_err, sizeof(g_err), "%s: bad IDX magic 0x%x", path, magic);
     munmap(map, static_cast<size_t>(st.st_size));
     return nullptr;
   }
-  if (static_cast<size_t>(st.st_size) <
-      header + item * static_cast<size_t>(count)) {
+  uint64_t avail = static_cast<uint64_t>(st.st_size) - header;
+  // count * item <= avail, without computing a wrappable product:
+  if (count != 0 && item > avail / count) {
     snprintf(g_err, sizeof(g_err), "%s: truncated payload", path);
     munmap(map, static_cast<size_t>(st.st_size));
     return nullptr;
   }
-  dims_out[0] = count;
-  dims_out[1] = rows;
-  dims_out[2] = cols;
+  uint64_t payload = count * item;  // now provably <= avail <= file size
+  dims_out[0] = static_cast<int64_t>(count);
+  dims_out[1] = static_cast<int64_t>(rows);
+  dims_out[2] = static_cast<int64_t>(cols);
+  dims_out[3] = static_cast<int64_t>(payload);
   *data_out = p + header;
   // Handle = the mapping base + size packed into a small struct.
   auto* h = new int64_t[2];
