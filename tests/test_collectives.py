@@ -92,6 +92,20 @@ class TestGroups:
         with pytest.raises(ValueError, match="not in group"):
             run(fn)
 
+    def test_group_all_gather(self):
+        g = comm.new_group([2, 5, 7])
+
+        def fn():
+            return comm.all_gather(
+                (comm.rank() * 1.0).reshape(1), group=g
+            )
+
+        out = np.asarray(run(fn))  # (N, 3, 1)
+        for r in (2, 5, 7):
+            np.testing.assert_allclose(out[r, :, 0], [2.0, 5.0, 7.0])
+        for r in (0, 1, 3, 4, 6):
+            np.testing.assert_allclose(out[r], 0.0)
+
     def test_group_gather(self):
         g = comm.new_group([0, 2])
 

@@ -200,12 +200,29 @@ def broadcast(
 
 
 def all_gather(
-    x: jax.Array, axis_name: str = DEFAULT_AXIS, *, axis: int = 0, tiled: bool = False
+    x: jax.Array,
+    axis_name: str = DEFAULT_AXIS,
+    *,
+    axis: int = 0,
+    tiled: bool = False,
+    group: Group | None = None,
 ) -> jax.Array:
     """``dist.all_gather(tensor_list, tensor)`` (tuto.md:199): every rank
     receives the stacked contributions (shape ``(n, ...)`` on a new leading
-    axis by default)."""
-    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    axis by default).  With ``group``, members receive the
+    ``(len(group), ...)`` stack of member contributions (sorted by rank)
+    and non-members receive zeros (``axis``/``tiled`` must be defaults)."""
+    if group is None:
+        return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+    if axis != 0 or tiled:
+        raise ValueError("group= supports the default axis=0, tiled=False")
+    n = lax.axis_size(axis_name)
+    stacked = lax.all_gather(x, axis_name, axis=0)  # (n, ...)
+    members = jnp.array(group.ranks)
+    member_stack = stacked[members]  # (len(group), ...)
+    return jnp.where(
+        group.is_member(axis_name), member_stack, jnp.zeros_like(member_stack)
+    )
 
 
 def gather(
