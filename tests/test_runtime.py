@@ -69,6 +69,60 @@ class TestRendezvous:
             runtime.rendezvous("127.0.0.1", port, 2, 0, timeout_ms=500)
 
 
+class TestFileRendezvous:
+    """The file:// init method (tuto.md:430-437 analog, fcntl-locked)."""
+
+    def test_explicit_ranks(self, tmp_path):
+        f = tmp_path / "rdzv"
+        out = {}
+
+        def run(r):
+            out[r] = runtime.file_rendezvous(f, 3, r, payload=f"h{r}")
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in range(3)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        table = out[0][1]
+        assert table == {0: "h0", 1: "h1", 2: "h2"}
+        assert all(out[r][1] == table for r in range(3))
+
+    def test_rankless_fcfs(self, tmp_path):
+        f = tmp_path / "rdzv"
+        got = []
+        lock = threading.Lock()
+
+        def run():
+            r, _ = runtime.file_rendezvous(f, 4, -1)
+            with lock:
+                got.append(r)
+
+        ts = [threading.Thread(target=run) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sorted(got) == [0, 1, 2, 3]
+
+    def test_timeout_when_short(self, tmp_path):
+        with pytest.raises(RuntimeError, match="before timeout"):
+            runtime.file_rendezvous(tmp_path / "rdzv", 2, 0, timeout_s=0.3)
+
+    def test_duplicate_rank_raises(self, tmp_path):
+        f = tmp_path / "rdzv"
+        t = threading.Thread(
+            target=lambda: runtime.file_rendezvous(f, 2, 0, timeout_s=2.0)
+        )
+        t.start()
+        try:
+            import time
+
+            time.sleep(0.2)  # let rank 0 register
+            with pytest.raises(RuntimeError, match="already registered"):
+                runtime.file_rendezvous(f, 2, 0, timeout_s=1.0)
+            # unblock the first thread
+            runtime.file_rendezvous(f, 2, 1, timeout_s=2.0)
+        finally:
+            t.join()
+
+
 class TestNativeIdxReader:
     def _write_pair(self, tmp_path):
         import struct

@@ -125,6 +125,83 @@ def free_port() -> int:
     return port
 
 
+def file_rendezvous(
+    path,
+    world: int,
+    rank: int = -1,
+    payload: str = "",
+    timeout_s: float = 30.0,
+) -> tuple[int, dict[int, str]]:
+    """Shared-filesystem rendezvous — the ``file://`` init method
+    (tuto.md:430-437): processes coordinate through one file guarded by
+    ``fcntl`` advisory locks (the same syscall the reference's C path
+    uses; Python's ``fcntl`` module is a direct wrapper).
+
+    Each process appends a ``rank payload`` registration under an
+    exclusive lock (``rank=-1`` takes the next free slot, FCFS like the
+    TCP master) and then polls until all ``world`` registrations exist.
+    Returns ``(my_rank, {rank: payload})``.  Single-host/multi-process
+    dev only — multi-host jobs should use the TCP `rendezvous`.
+    """
+    import fcntl
+    import time
+    from pathlib import Path as _Path
+
+    path = _Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    deadline = time.monotonic() + timeout_s
+
+    def read_table(f) -> dict[int, str]:
+        f.seek(0)
+        table: dict[int, str] = {}
+        for line in f.read().decode().splitlines():
+            r, _, pl = line.partition(" ")
+            table[int(r)] = pl
+        return table
+
+    my_rank = None
+    with open(path, "a+b") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            table = read_table(f)
+            if rank >= 0:
+                if rank in table:
+                    raise RuntimeError(
+                        f"file rendezvous: rank {rank} already registered "
+                        f"in {path}"
+                    )
+                my_rank = rank
+            else:
+                my_rank = next(
+                    r for r in range(world) if r not in table
+                )
+            if len(table) >= world:
+                raise RuntimeError(
+                    f"file rendezvous: {path} already has {len(table)} "
+                    f"registrations for world {world} (stale file?)"
+                )
+            f.write(f"{my_rank} {payload}\n".encode())
+            f.flush()
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+    # Startup barrier: wait until every slot is registered.
+    while True:
+        with open(path, "rb") as f:
+            fcntl.flock(f, fcntl.LOCK_SH)
+            try:
+                table = read_table(f)
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+        if len(table) >= world:
+            return my_rank, table
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"file rendezvous: only {len(table)}/{world} processes "
+                f"registered in {path} before timeout"
+            )
+        time.sleep(0.05)
+
+
 def rendezvous(
     addr: str,
     port: int,
