@@ -122,6 +122,27 @@ def test_trace_dir_writes_profile(tmp_path, mesh, dataset):
     assert found, "profiler trace directory is empty"
 
 
+def test_scheduled_optimizer_state_checkpoints(tmp_path, mesh, dataset):
+    """The schedule's step counter must survive save/restore (resume
+    continues the schedule, not restart it)."""
+    from tpu_dist.train import schedule
+
+    cfg = train.TrainConfig(epochs=1, log=lambda s: None)
+    opt = train.sgd(schedule.cosine(0.01, 100, warmup_steps=5), momentum=0.5)
+    t = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, cfg, optimizer=opt
+    )
+    t.fit(dataset)
+    steps_before = int(np.asarray(t.opt_state["step"]))
+    assert steps_before > 0
+    t.save(tmp_path / "ck.npz", epoch=1)
+    t2 = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, cfg, optimizer=opt
+    )
+    t2.restore(tmp_path / "ck.npz")
+    assert int(np.asarray(t2.opt_state["step"])) == steps_before
+
+
 def test_orbax_checkpoint_roundtrip(tmp_path):
     import jax.numpy as jnp
 
