@@ -22,21 +22,46 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def measure(world: int, batch_per_chip: int, steps: int, platform: str | None):
+def _build_model(name: str):
+    from tpu_dist import models
+
+    if name == "mnist":
+        return models.mnist_net(), models.IN_SHAPE
+    if name == "resnet18":
+        return models.resnet18(num_classes=10), (32, 32, 3)
+    if name == "vit":
+        # ViT-Ti/16 at ImageNet resolution — BASELINE.json config 5
+        return models.vit_tiny(image_size=224, patch=16, num_classes=1000), (
+            224, 224, 3,
+        )
+    raise SystemExit(f"unknown --model {name!r}")
+
+
+def measure(
+    world: int,
+    batch_per_chip: int,
+    steps: int,
+    platform: str | None,
+    model_name: str = "mnist",
+):
     import jax
     import jax.numpy as jnp
 
     from tpu_dist import comm, models, nn, parallel, train
 
     mesh = comm.make_mesh(world, ("data",), platform=platform)
-    model = models.mnist_net()
-    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+    model, in_shape = _build_model(model_name)
+    params, state = model.init(jax.random.key(0), in_shape)
     opt = train.sgd(0.01, momentum=0.5)
+
+    # name must not collide with the step-output `loss` below — the
+    # closure resolves at trace time in this scope
+    loss_metric = nn.nll_loss if model_name == "mnist" else nn.cross_entropy
 
     def loss_fn(p, s, batch, key):
         x, y = batch
         scores, s2 = model.apply(p, s, x, train=True, key=key)
-        return nn.nll_loss(scores, y), (s2, {})
+        return loss_metric(scores, y), (s2, {})
 
     step = parallel.make_stateful_train_step(loss_fn, opt, mesh)
     p = parallel.replicate(params, mesh)
@@ -45,7 +70,7 @@ def measure(world: int, batch_per_chip: int, steps: int, platform: str | None):
     global_batch = batch_per_chip * world
     batch = parallel.shard_batch(
         (
-            jnp.zeros((global_batch,) + models.IN_SHAPE, jnp.float32),
+            jnp.zeros((global_batch,) + in_shape, jnp.float32),
             jnp.zeros((global_batch,), jnp.int32),
         ),
         mesh,
@@ -68,6 +93,7 @@ def main():
     ap.add_argument("--batch-per-chip", type=int, default=64)
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--max-world", type=int, default=None)
+    ap.add_argument("--model", default="mnist", help="mnist | resnet18 | vit")
     args = ap.parse_args()
     if args.platform == "cpu":
         os.environ["XLA_FLAGS"] = (
@@ -85,7 +111,8 @@ def main():
 
     results = {}
     for w in worlds:
-        sps = measure(w, args.batch_per_chip, args.steps, args.platform)
+        sps = measure(w, args.batch_per_chip, args.steps, args.platform,
+                      model_name=args.model)
         results[w] = sps
         print(
             f"world={w:3d}  {sps:12,.0f} samples/s  "
@@ -105,7 +132,8 @@ def main():
         f"scaling efficiency {worlds[0]}->{worlds[-1]}: {eff_last:.1%}",
         file=sys.stderr,
     )
-    print(json.dumps({"metric": "dp_weak_scaling", "worlds": table}))
+    print(json.dumps({"metric": "dp_weak_scaling", "model": args.model,
+                      "worlds": table}))
 
 
 if __name__ == "__main__":
