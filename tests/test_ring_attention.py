@@ -102,6 +102,26 @@ def test_ulysses_indivisible_heads_raises():
         run(fn, q, world=4)
 
 
+def test_reduce_scatter_nonsum_ops():
+    """MAX/MIN/PRODUCT take the generic fallback path with identical
+    tiled chunk semantics to SUM."""
+
+    def fn():
+        x = jnp.arange(8.0) + comm.rank() * 10.0
+        return (
+            comm.reduce_scatter(x, comm.ReduceOp.MAX),
+            comm.reduce_scatter(x, comm.ReduceOp.MIN),
+        )
+
+    mx, mn = run(fn, world=4)
+    mx, mn = np.asarray(mx), np.asarray(mn)
+    full_max = np.arange(8.0) + 30.0  # rank 3 dominates
+    full_min = np.arange(8.0)  # rank 0
+    for r in range(4):
+        np.testing.assert_allclose(mx[r], full_max[2 * r : 2 * r + 2])
+        np.testing.assert_allclose(mn[r], full_min[2 * r : 2 * r + 2])
+
+
 def test_reduce_scatter_and_all_to_all_collectives():
     def fn():
         x = (comm.rank() + 1.0) * jnp.arange(8.0)
