@@ -111,6 +111,17 @@ def main():
     assert res[0][-1] < res[0][0], f"loss did not decrease: {res[0]}"
     print("MULTIPROCESS TRAIN OK", res[0][:2], "...", res[0][-1])
 
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        res = launch(
+            psum_worker, world, platform="cpu",
+            devices_per_proc=devices_per_proc,
+            init_method=f"file://{d}/rdzv",
+        )
+        assert res == expect, f"file:// init: {res} != {expect}"
+        print("MULTIPROCESS FILE-INIT OK", res)
+
     import time
 
     t0 = time.perf_counter()

@@ -85,32 +85,49 @@ def init(
         # backend initialization to take effect.
         jax.config.update("jax_platforms", cfg.platform)
     if cfg.num_processes and cfg.num_processes > 1:
-        if cfg.coordinator_address is None:
-            raise ValueError(
-                "multi-process init needs MASTER_ADDR/MASTER_PORT (or an "
-                "explicit coordinator_address) — tuto.md:421-428 contract"
-            )
-        addr, _, port_s = cfg.coordinator_address.partition(":")
-        port = int(port_s)
-        # Native bootstrap (tpu_dist/runtime/rendezvous.cc): startup
-        # barrier + rank assignment (process_id=None → master-assigned,
-        # the MPI-style rank-less path of allreduce.py:54).
         from tpu_dist import runtime
 
         rank = cfg.process_id if cfg.process_id is not None else -1
-        my_rank, _peers = runtime.rendezvous(
-            addr, port, cfg.num_processes, rank, payload=os.uname().nodename
-        )
+        init_method = os.environ.get("TPU_DIST_INIT_METHOD", "")
+        if init_method.startswith("file://"):
+            # file:// init (tuto.md:430-437): rank assignment + startup
+            # barrier through an fcntl-locked file; the process that gets
+            # rank 0 publishes the JAX coordinator address as its payload
+            # (every payload carries a candidate; rank 0's wins).
+            path = init_method[len("file://"):]
+            candidate = f"127.0.0.1:{runtime.free_port()}"
+            my_rank, peers = runtime.file_rendezvous(
+                path, cfg.num_processes, rank, payload=candidate
+            )
+            coordinator = peers[0]
+        else:
+            if cfg.coordinator_address is None:
+                raise ValueError(
+                    "multi-process init needs MASTER_ADDR/MASTER_PORT, an "
+                    "explicit coordinator_address (tuto.md:421-428 "
+                    "contract), or TPU_DIST_INIT_METHOD=file:///path"
+                )
+            addr, _, port_s = cfg.coordinator_address.partition(":")
+            port = int(port_s)
+            # Native TCP bootstrap (tpu_dist/runtime/rendezvous.cc):
+            # startup barrier + rank assignment (process_id=None →
+            # master-assigned, the MPI-style rank-less path of
+            # allreduce.py:54).
+            my_rank, _peers = runtime.rendezvous(
+                addr, port, cfg.num_processes, rank,
+                payload=os.uname().nodename,
+            )
+            # Steady-state coordinator: one port above the rendezvous
+            # port — both come from the same MASTER contract.
+            coordinator = f"{addr}:{port + 1}"
         cfg = InitConfig(
-            coordinator_address=cfg.coordinator_address,
+            coordinator_address=coordinator,
             num_processes=cfg.num_processes,
             process_id=my_rank,
             platform=cfg.platform,
         )
-        # Steady-state runtime: XLA's coordination service (one port above
-        # the rendezvous port — both come from the same MASTER contract).
         jax.distributed.initialize(
-            coordinator_address=f"{addr}:{port + 1}",
+            coordinator_address=coordinator,
             num_processes=cfg.num_processes,
             process_id=my_rank,
         )

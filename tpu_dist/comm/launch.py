@@ -29,10 +29,14 @@ import traceback
 from typing import Any, Callable
 
 
-def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc):
+def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc,
+           init_method=None):
     try:
-        os.environ["MASTER_ADDR"] = addr
-        os.environ["MASTER_PORT"] = str(port)
+        if init_method:
+            os.environ["TPU_DIST_INIT_METHOD"] = init_method
+        else:
+            os.environ["MASTER_ADDR"] = addr
+            os.environ["MASTER_PORT"] = str(port)
         os.environ["WORLD_SIZE"] = str(world)
         os.environ["RANK"] = str(rank)
         if platform == "cpu" and devices_per_proc:
@@ -61,13 +65,15 @@ def launch(
     port: int | None = None,
     devices_per_proc: int = 1,
     timeout: float = 300.0,
+    init_method: str | None = None,
 ) -> list[Any]:
     """Fork-join ``world`` processes running ``fn(rank, world)``.
 
     ``fn`` must be picklable (module-level).  Returns each rank's result,
     index = rank.  Any child failure raises, fail-stop, after terminating
     the others (the reference's failure model: blocked peers + ``join()``,
-    SURVEY.md §5).
+    SURVEY.md §5).  ``init_method='file:///path'`` bootstraps through the
+    fcntl file rendezvous instead of the TCP master (tuto.md:430-437).
     """
     from tpu_dist import runtime
 
@@ -80,7 +86,7 @@ def launch(
         p = ctx.Process(
             target=_child,
             args=(fn, rank, world, addr, port, platform, child_conn,
-                  devices_per_proc),
+                  devices_per_proc, init_method),
         )
         p.start()
         procs.append(p)
