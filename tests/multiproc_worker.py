@@ -84,6 +84,45 @@ def train_worker(rank, world):
     return losses
 
 
+def single_process_reference(n_dev=4):
+    """The same training config as train_worker, in ONE process over a
+    local n_dev mesh — used to assert process-topology invariance."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpu_dist import data, models, nn, parallel, train
+
+    devs = jax.devices()[:n_dev]
+    mesh = Mesh(np.array(devs), ("data",))
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(1234), models.IN_SHAPE)
+    opt = train.sgd(0.01, momentum=0.5)
+
+    def loss_fn(p, s, batch, key):
+        x, y = batch
+        scores, s2 = model.apply(p, s, x, train=True, key=key)
+        return nn.nll_loss(scores, y), (s2, {})
+
+    step = parallel.make_stateful_train_step(loss_fn, opt, mesh, donate=False)
+    p = parallel.replicate(params, mesh)
+    ms = parallel.replicate(state, mesh)
+    os_ = parallel.replicate(opt.init(params), mesh)
+    ds = data.load_mnist("train", synthetic_size=n_dev * 16 * 4)
+    loader = data.DistributedLoader(ds, n_dev, n_dev * 16)
+    losses = []
+    for bi, (x, y) in enumerate(loader.epoch(0)):
+        batch = parallel.shard_batch((x, y), mesh)
+        p, ms, os_, loss, _ = step(p, ms, os_, batch, jax.random.key(bi))
+        losses.append(round(float(loss), 6))
+    return losses
+
+
+def reference_runner(rank, world):
+    """Module-level wrapper (spawn needs picklable targets)."""
+    return single_process_reference(n_dev=4)
+
+
 def failing_worker(rank, world):
     """Failure-injection: rank 1 dies during init (before the barrier
     completes for anyone) — the launcher must fail-stop quickly with the
@@ -110,6 +149,15 @@ def main():
     assert res[0] == res[1], f"loss trajectories diverged: {res}"
     assert res[0][-1] < res[0][0], f"loss did not decrease: {res[0]}"
     print("MULTIPROCESS TRAIN OK", res[0][:2], "...", res[0][-1])
+
+    # Process-topology invariance: the same 4-device config in ONE
+    # process must produce the identical loss trajectory (determinism is
+    # a property of the global program, not the process layout).
+    ref = launch(reference_runner, 1, platform="cpu", devices_per_proc=4)[0]
+    assert ref == res[0], (
+        f"process layout changed training: 1-proc {ref} vs 2-proc {res[0]}"
+    )
+    print("MULTIPROCESS TOPOLOGY-INVARIANCE OK")
 
     import tempfile
 
