@@ -25,7 +25,11 @@ import pytest  # noqa: E402
 # ignores the JAX_PLATFORMS env var (it rewrites platform selection at
 # interpreter startup) — the config override below still wins because no
 # backend has been initialized yet at conftest-import time.
-jax.config.update("jax_platforms", "cpu")
+# TPU_DIST_TEST_TPU=1 leaves the real backend available for the
+# tpu-marked hardware tests (run those as:
+#   TPU_DIST_TEST_TPU=1 pytest tests/test_tpu_hardware.py -m tpu).
+if os.environ.get("TPU_DIST_TEST_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 

@@ -88,7 +88,14 @@ def init(
         from tpu_dist import runtime
 
         rank = cfg.process_id if cfg.process_id is not None else -1
-        init_method = os.environ.get("TPU_DIST_INIT_METHOD", "")
+        # Precedence matches every other parameter: an EXPLICIT
+        # coordinator_address argument beats the env-var init method (a
+        # stale exported TPU_DIST_INIT_METHOD must not hijack a job that
+        # names its coordinator).
+        init_method = (
+            "" if coordinator_address is not None
+            else os.environ.get("TPU_DIST_INIT_METHOD", "")
+        )
         if init_method.startswith("file://"):
             # file:// init (tuto.md:430-437): rank assignment + startup
             # barrier through an fcntl-locked file; the process that gets

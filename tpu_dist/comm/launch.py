@@ -35,6 +35,9 @@ def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc,
         if init_method:
             os.environ["TPU_DIST_INIT_METHOD"] = init_method
         else:
+            # an inherited env var must not override this launch's TCP
+            # bootstrap (explicit configuration wins)
+            os.environ.pop("TPU_DIST_INIT_METHOD", None)
             os.environ["MASTER_ADDR"] = addr
             os.environ["MASTER_PORT"] = str(port)
         os.environ["WORLD_SIZE"] = str(world)
