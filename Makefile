@@ -7,7 +7,7 @@ PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
 .PHONY: test ptp gather allreduce train bench runtime train-image \
-        scaling multiproc longcontext train-lm docs
+        scaling multiproc longcontext train-lm docs demos
 
 test:
 	$(PY) -m pytest tests/ -x -q
