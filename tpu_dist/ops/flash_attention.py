@@ -111,47 +111,137 @@ def _flash_fwd(q3, k3, v3, causal, bq, bk, interpret):
     return out, (q3, k3, v3, out, lse)
 
 
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
+                *, bq: int, causal: bool):
+    """Backward kernel A: one program per (batch·head, KEY block);
+    scans query blocks accumulating dK, dV for this key block in f32."""
+    ks = k_ref[0].astype(jnp.float32)  # (bk, d)
+    vs = v_ref[0].astype(jnp.float32)
+    bk_, d = ks.shape
+    S = q_ref.shape[1]
+    scale = d**-0.5
+    j = pl.program_id(1)
+    nq = S // bq
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * bq, bq)]
+        dd = d_ref[0, pl.ds(qi * bq, bq)]
+        logits = jnp.dot(q * scale, ks.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk_), 0)
+            k_pos = j * bk_ + lax.broadcasted_iota(jnp.int32, (bq, bk_), 1)
+            mask = q_pos >= k_pos
+            logits = jnp.where(mask, logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, None])  # (bq, bk)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, vs.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dd[:, None])
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    if causal:
+        # query blocks before this key block's diagonal are fully masked
+        lo = (j * bk_) // bq
+    else:
+        lo = 0
+    dk0 = jnp.zeros((bk_, d), jnp.float32)
+    dv0 = jnp.zeros((bk_, d), jnp.float32)
+    dk, dv = lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
+               *, bk: int, causal: bool):
+    """Backward kernel B: one program per (batch·head, QUERY block);
+    scans key blocks accumulating dQ in f32."""
+    q = q_ref[0].astype(jnp.float32)  # (bq, d)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    dd = d_ref[0]
+    bq_, d = q.shape
+    S = k_ref.shape[1]
+    scale = d**-0.5
+    i = pl.program_id(1)
+    nk = S // bk
+
+    def body(j, dq):
+        ks = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vs = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        logits = jnp.dot(q * scale, ks.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = i * bq_ + lax.broadcasted_iota(jnp.int32, (bq_, bk), 0)
+            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq_, bk), 1)
+            mask = q_pos >= k_pos
+            logits = jnp.where(mask, logits, NEG_INF)
+        p = jnp.exp(logits - lse[:, None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        dp = jnp.dot(do, vs.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - dd[:, None])
+        return dq + jnp.dot(ds, ks, preferred_element_type=jnp.float32) * scale
+
+    hi = lax.min(nk, ((i + 1) * bq_ + bk - 1) // bk) if causal else nk
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq_, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
 def _flash_bwd(causal, bq, bk, interpret, res, g):
-    """Blockwise backward in plain XLA: one `lax.scan` over key blocks,
-    peak intermediate (S, bk) — the (S, S) score matrix is never formed.
+    """Backward via two Pallas kernels (dK/dV by key block, dQ by query
+    block) — the (S, S) score matrix is never formed on either pass.
     Standard flash recurrence: with P = exp(logits - lse) and
     D = rowsum(dO ∘ O),  dV_j = Pᵀ dO,  dS = P ∘ (dO Vᵀ − D),
     dQ += dS K_j · scale,  dK_j = dSᵀ Q · scale."""
     q3, k3, v3, out, lse = res
     bh, S, d = q3.shape
-    scale = d**-0.5
-    qf = q3.astype(jnp.float32)
-    kf = k3.astype(jnp.float32)
-    vf = v3.astype(jnp.float32)
-    go = g.astype(jnp.float32)
-    D = jnp.sum(go * out.astype(jnp.float32), axis=-1)  # (bh, S)
-    nb = S // bk
-    pos_q = jnp.arange(S)
+    go = g.astype(q3.dtype)
+    D = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (bh, S) f32
 
-    def block(carry, j):
-        dq = carry
-        ks = lax.dynamic_slice_in_dim(kf, j * bk, bk, 1)  # (bh, bk, d)
-        vs = lax.dynamic_slice_in_dim(vf, j * bk, bk, 1)
-        logits = jnp.einsum("bsd,btd->bst", qf * scale, ks)  # (bh, S, bk)
-        if causal:
-            pos_k = j * bk + jnp.arange(bk)
-            mask = pos_q[:, None] >= pos_k[None, :]
-            logits = jnp.where(mask, logits, NEG_INF)
-        p = jnp.exp(logits - lse[..., None])  # (bh, S, bk)
-        if causal:
-            p = jnp.where(mask, p, 0.0)
-        dv = jnp.einsum("bst,bsd->btd", p, go)
-        dp = jnp.einsum("bsd,btd->bst", go, vs)
-        ds = p * (dp - D[..., None])
-        dq = dq + jnp.einsum("bst,btd->bsd", ds, ks) * scale
-        dk = jnp.einsum("bst,bsd->btd", ds, qf) * scale
-        return dq, (dk, dv)
-
-    dq, (dks, dvs) = lax.scan(block, jnp.zeros_like(qf), jnp.arange(nb))
-    # scan stacks per-block dk/dv as (nb, bh, bk, d) -> (bh, S, d)
-    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, S, d)
-    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, S, d)
-    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+    full = pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0))
+    row_full = pl.BlockSpec((1, S), lambda b, i: (b, 0))
+    params = (
+        None
+        if interpret
+        else pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, causal=causal),
+        grid=(bh, S // bk),
+        in_specs=[full, pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+                  pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+                  full, row_full, row_full],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, S, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, S, d), v3.dtype),
+        ],
+        compiler_params=params,
+        interpret=interpret,
+    )(q3, k3, v3, go, lse, D)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bk=bk, causal=causal),
+        grid=(bh, S // bq),
+        in_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+                  full, full,
+                  pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+                  pl.BlockSpec((1, bq), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, S, d), q3.dtype),
+        compiler_params=params,
+        interpret=interpret,
+    )(q3, k3, v3, go, lse, D)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
