@@ -142,6 +142,37 @@ def test_reduce_scatter_and_all_to_all_collectives():
         np.testing.assert_allclose(a2a[r], expect)
 
 
+def test_ring_attention_bf16_accumulates_in_f32():
+    """bf16 inputs (MXU-native) with long-ish accumulation: output must
+    track the f32 dense reference within bf16 tolerance — the f32
+    streaming-softmax accumulators are what make this hold."""
+    key = jax.random.key(21)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (1, 2, 64, 16)
+    qf = jax.random.normal(kq, shape)
+    kf = jax.random.normal(kk, shape)
+    vf = jax.random.normal(kv, shape)
+    full = dot_product_attention(qf, kf, vf)
+
+    def fn(q, k, v):
+        r = comm.rank()
+        sl = lambda x: jax.lax.dynamic_slice_in_dim(x, r * 16, 16, 2)
+        out = parallel.ring_attention(
+            sl(q).astype(jnp.bfloat16),
+            sl(k).astype(jnp.bfloat16),
+            sl(v).astype(jnp.bfloat16),
+            comm.DEFAULT_AXIS,
+        )
+        assert out.dtype == jnp.bfloat16  # output stays in input dtype
+        return out.astype(jnp.float32)
+
+    out = np.asarray(run(fn, qf, kf, vf, world=N))
+    gathered = np.concatenate([out[r] for r in range(N)], axis=2)
+    np.testing.assert_allclose(
+        gathered, np.asarray(full), rtol=0.05, atol=0.05
+    )
+
+
 def test_ring_attention_single_device():
     q, k, v = _make_qkv()
 
