@@ -50,6 +50,7 @@ def pipeline_apply(
     *,
     n_microbatches: int,
     axis_name: str = PIPE_AXIS,
+    remat_stages: bool = False,
 ) -> jax.Array:
     """Run the staged model over the pipeline.
 
@@ -62,6 +63,10 @@ def pipeline_apply(
       x: the FULL local batch ``(B, ...)`` (replicated input); it is split
         into ``n_microbatches`` microbatches of ``B // n_microbatches``.
       n_microbatches: M; must divide B.
+      remat_stages: rematerialize each stage's forward during backward
+        (``jax.checkpoint``): activation memory per device drops from
+        O(ticks) scan residuals to O(1) per tick at the cost of one extra
+        stage forward — the standard pipeline-training memory trade.
 
     Returns the full output batch ``(B, ...)``, valid on every rank (the
     last stage's results are broadcast back over the ring as part of the
@@ -75,6 +80,8 @@ def pipeline_apply(
             f"batch {B} not divisible by n_microbatches {n_microbatches}"
         )
     mb = B // n_microbatches
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
     micro = x.reshape((n_microbatches, mb) + x.shape[1:])
     perm = ring_perm(n)
     ticks = n_microbatches + n - 1
