@@ -10,6 +10,19 @@ from tests.conftest import spmd_run as run
 from tpu_dist import comm, data, utils
 
 
+def test_make_mesh_errors():
+    with pytest.raises(ValueError, match="shape required"):
+        comm.make_mesh(None, ("a", "b"), platform="cpu")
+    with pytest.raises(ValueError, match="needs 64 devices"):
+        comm.make_mesh((8, 8), ("a", "b"), platform="cpu")
+
+
+def test_make_mesh_explicit_devices():
+    devs = comm.devices("cpu")[:4]
+    mesh = comm.make_mesh(4, ("x",), mesh_devices=devs)
+    assert list(mesh.devices.flat) == devs
+
+
 def test_barrier_is_noop_value_wise():
     def fn():
         x = comm.rank() * 1.0
