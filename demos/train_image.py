@@ -14,6 +14,7 @@ def main():
     args = parse_args(
         default_world=None,
         model=(str, "resnet18", "resnet18 | vit"),
+        dataset=(str, "cifar10", "cifar10 | imagenet (synthetic, 224px)"),
         epochs=(int, 2, "training epochs"),
         samples=(int, 4096, "cap dataset size (0 = full)"),
         batch=(int, 128, "global batch size"),
@@ -23,17 +24,35 @@ def main():
 
     world = args.world or len(comm.devices(args.platform))
     mesh = comm.make_mesh(world, ("data",), platform=args.platform)
-    ds = data.load_cifar10("train", limit=args.samples or None)
+    if args.dataset == "imagenet":
+        # BASELINE config 5: ViT-Ti/16 at ImageNet resolution
+        n = args.samples or 1024
+        ds = data.synthetic_images(n, shape=(224, 224, 3), classes=1000)
+        test_ds = data.synthetic_images(
+            min(256, n), shape=(224, 224, 3), classes=1000, seed=1
+        )
+        in_shape, classes = (224, 224, 3), 1000
+    elif args.dataset == "cifar10":
+        ds = data.load_cifar10("train", limit=args.samples or None)
+        test_ds = data.load_cifar10(
+            "test", limit=min(2000, len(ds)) if ds.synthetic else None
+        )
+        in_shape, classes = (32, 32, 3), 10
+    else:
+        raise SystemExit(f"unknown --dataset {args.dataset!r}")
     kind = "synthetic" if ds.synthetic else "real"
 
     if args.model == "resnet18":
-        model, in_shape = models.resnet18(num_classes=10), (32, 32, 3)
+        model = models.resnet18(num_classes=classes)
     elif args.model == "vit":
-        model, in_shape = models.vit_tiny(image_size=32, patch=4, num_classes=10), (32, 32, 3)
+        if args.dataset == "imagenet":
+            model = models.vit_tiny(image_size=224, patch=16, num_classes=classes)
+        else:
+            model = models.vit_tiny(image_size=32, patch=4, num_classes=classes)
     else:
         raise SystemExit(f"unknown --model {args.model!r}")
 
-    print(f"{args.model} on CIFAR-10 ({kind}, {len(ds)} samples), "
+    print(f"{args.model} on {args.dataset} ({kind}, {len(ds)} samples), "
           f"{world} ranks [{mesh.devices.flat[0].platform}]"
           f"{' bf16' if args.bf16 else ''}")
     cfg = train.TrainConfig(
@@ -45,8 +64,7 @@ def main():
     )
     trainer = train.Trainer(model, in_shape, mesh, cfg, loss=nn.cross_entropy)
     trainer.fit(ds)
-    test = data.load_cifar10("test", limit=min(2000, len(ds)) if ds.synthetic else None)
-    print(f"Test accuracy: {trainer.evaluate(test, batch_size=500):.4f}")
+    print(f"Test accuracy: {trainer.evaluate(test_ds, batch_size=256):.4f}")
 
 
 if __name__ == "__main__":

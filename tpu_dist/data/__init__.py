@@ -1,6 +1,6 @@
 """`tpu_dist.data` — partitioning and loading (SURVEY.md §1 L4)."""
 
-from tpu_dist.data.cifar import load_cifar10, synthetic_cifar10
+from tpu_dist.data.cifar import load_cifar10, synthetic_cifar10, synthetic_images
 from tpu_dist.data.loader import DistributedLoader, Loader, prefetch_to_mesh
 from tpu_dist.data.mnist import (
     Dataset,
@@ -24,5 +24,6 @@ __all__ = [
     "load_mnist",
     "prefetch_to_mesh",
     "synthetic_cifar10",
+    "synthetic_images",
     "synthetic_mnist",
 ]

@@ -61,6 +61,37 @@ def synthetic_cifar10(n: int, *, seed: int = 0) -> Dataset:
     )
 
 
+def synthetic_images(
+    n: int,
+    *,
+    shape: tuple[int, int, int] = (224, 224, 3),
+    classes: int = 1000,
+    seed: int = 0,
+) -> Dataset:
+    """Generic deterministic image-classification stand-in at arbitrary
+    resolution/class count — the ImageNet-shaped path for BASELINE config
+    5 (ViT-Ti/16 @ 224) in zero-egress environments.  Same template+noise
+    scheme as the MNIST/CIFAR generators (fixed-seed class templates, so
+    train/test splits share classes)."""
+    h, w, c = shape
+    if h % 8 or w % 8:
+        raise ValueError(f"image dims {shape} must be multiples of 8")
+    trng = np.random.default_rng(777)
+    low = trng.normal(size=(classes, h // 8, w // 8, c)).astype(np.float32)
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, classes, size=n).astype(np.int32)
+    # upsample per-sample to keep memory bounded at large class counts
+    imgs = np.empty((n, h, w, c), np.float32)
+    noise_scale = 0.25
+    for i in range(n):
+        t = low[labels[i]].repeat(8, axis=0).repeat(8, axis=1)
+        t = (t - t.min()) / (np.ptp(t) + 1e-9)
+        imgs[i] = np.clip(
+            t + rng.normal(scale=noise_scale, size=(h, w, c)), 0.0, 1.0
+        )
+    return Dataset(imgs.astype(np.float32), labels, synthetic=True)
+
+
 def load_cifar10(split: str = "train", *, limit: int | None = None) -> Dataset:
     files = (
         [f"data_batch_{i}.bin" for i in range(1, 6)]
