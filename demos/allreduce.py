@@ -16,9 +16,7 @@ repeats of a large allreduce, reporting achieved bus GB/s for both paths.
 
 import time
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 
 from _common import parse_args
 

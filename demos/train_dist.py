@@ -44,7 +44,6 @@ def main():
     start_epoch = 0
     if args.ckpt:
         import glob
-        import os as _os
 
         ckpts = sorted(
             glob.glob(f"{args.ckpt}/ckpt_*.npz"),

@@ -4,7 +4,6 @@ inside, gradient averaging over BOTH axes.  The full-stack configuration
 the framework exists for."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax import lax

@@ -4,9 +4,8 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from tpu_dist import comm
 from tpu_dist.comm.init import InitConfig

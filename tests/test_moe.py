@@ -4,10 +4,9 @@ implementation of the same routing."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from tests.conftest import spmd_run as run
-from tpu_dist import comm, parallel
+from tpu_dist import comm
 from tpu_dist.parallel.moe import capacity_for, moe_mlp, stack_expert_params
 
 N = 4  # experts = ranks

@@ -2,7 +2,6 @@
 forward (the long-context guarantee)."""
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -9,7 +9,6 @@ guarantees)."""
 from __future__ import annotations
 
 import html
-import sys
 from pathlib import Path
 
 TEMPLATE = """<!doctype html>
