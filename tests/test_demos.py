@@ -1,0 +1,55 @@
+"""Demo-surface tests: the reference's self-verifying prints (SURVEY.md
+§4.1) locked in CI — each demo runs as a real subprocess CLI and its
+known-answer output is asserted."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+DEMOS = Path(__file__).parent.parent / "demos"
+
+pytestmark = pytest.mark.slow
+
+
+def run_demo(script: str, *args: str, timeout: int = 240) -> str:
+    proc = subprocess.run(
+        [sys.executable, script, *args],
+        cwd=DEMOS,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_ptp_known_answer():
+    out = run_demo("ptp.py", "--world", "2", "--platform", "cpu")
+    assert "Rank 0 has data 1.0 after ping" in out
+    assert "Rank 1 has data 1.0 after ping" in out
+    assert out.count("2.0 after pong") == 2
+
+
+def test_gather_known_answer():
+    out = run_demo("gather.py", "--world", "4", "--platform", "cpu")
+    assert "Rank 0 sum after gather: 4.0" in out
+
+
+def test_allreduce_known_answer():
+    out = run_demo("allreduce.py", "--world", "4", "--platform", "cpu")
+    assert out.count("psum=256 ring=256") == 4
+
+
+def test_train_dist_loss_decreases():
+    out = run_demo(
+        "train_dist.py", "--world", "4", "--platform", "cpu",
+        "--epochs", "2", "--samples", "1024", timeout=400,
+    )
+    lines = [l for l in out.splitlines() if "epoch" in l]
+    assert len(lines) == 2
+    first = float(lines[0].rsplit(":", 1)[1].split("[")[0])
+    last = float(lines[-1].rsplit(":", 1)[1].split("[")[0])
+    assert last < first, out
+    assert "Test accuracy:" in out
