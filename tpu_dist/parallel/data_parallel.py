@@ -42,6 +42,12 @@ def average_gradients(
     all_reduce per parameter (and without the reference's type-guard bug,
     SURVEY.md §2c.2).
 
+    Per-tensor observable behavior (SURVEY.md §7 hard part (b)): the tree
+    map issues one collective PER PARAMETER — exactly the reference's
+    loop structure — and XLA's combiner then buckets/fuses them; the
+    per-tensor semantics are preserved at the program level while the
+    schedule gets the fusion the reference lacks (tuto.md:319-320).
+
     ``backend='ring'`` swaps in the hand-rolled chunked ppermute ring
     (`tpu_dist.parallel.ring_all_reduce_chunked`) — the reference's
     allreduce.py path used for its real purpose.  Numerically equivalent
