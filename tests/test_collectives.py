@@ -183,6 +183,19 @@ class TestDataMovement:
         expect[1], expect[4] = 100.0, 200.0
         np.testing.assert_allclose(out, expect)
 
+    def test_out_of_range_roots_raise(self):
+        with pytest.raises(ValueError, match="broadcast root 8 out of range"):
+            run(lambda: comm.broadcast(jnp.ones(()), src=8))
+        with pytest.raises(ValueError, match="gather root -1 out of range"):
+            run(lambda: comm.gather(jnp.ones(1), dst=-1))
+        with pytest.raises(ValueError, match="reduce root 9 out of range"):
+            run(lambda: comm.reduce(jnp.ones(()), dst=9))
+
+    def test_group_reduce_nonmember_dst_raises(self):
+        g = comm.new_group([0, 1])
+        with pytest.raises(ValueError, match="reduce dst 3 not in group"):
+            run(lambda: comm.reduce(jnp.ones(()), dst=3, group=g))
+
     def test_reduce_root_only(self):
         def fn():
             return comm.reduce(jnp.ones(()), dst=5)

@@ -58,6 +58,24 @@ class TestRendezvous:
         [t.join() for t in ts]
         assert sorted(out) == [0, 1, 2, 3]
 
+    def test_all_rankless_master_election(self):
+        """mpirun-style launch: EVERY process is rank-less; exactly one
+        must win the bind race and become master (this used to deadlock
+        — no process ever bound the port)."""
+        port = runtime.free_port()
+        out = []
+        lock = threading.Lock()
+
+        def run():
+            r, peers = runtime.rendezvous("127.0.0.1", port, 4, -1, payload="x")
+            with lock:
+                out.append(r)
+
+        ts = [threading.Thread(target=run) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sorted(out) == [0, 1, 2, 3]
+
     def test_worker_timeout_without_master(self):
         port = runtime.free_port()
         with pytest.raises(RuntimeError, match="rendezvous failed"):

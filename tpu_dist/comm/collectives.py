@@ -154,6 +154,15 @@ def all_reduce(
     return jnp.where(group.is_member(axis_name), reduced, x)
 
 
+def _check_root(root: int, axis_name: str, what: str) -> None:
+    n = lax.axis_size(axis_name)
+    if not 0 <= root < n:
+        raise ValueError(
+            f"{what} root {root} out of range for world size {n} — a "
+            f"masked select would silently produce zeros/passthrough"
+        )
+
+
 def reduce(
     x: jax.Array,
     dst: int,
@@ -166,8 +175,12 @@ def reduce(
     (tuto.md:196).  TPU collectives are symmetric; "root" is a post-hoc
     select: dst receives the reduction, other ranks keep their input
     (torch leaves non-dst buffers unspecified; passthrough is our defined
-    behavior).
+    behavior).  With ``group``, dst must be a member (non-members must
+    never observe the group's reduction).
     """
+    _check_root(dst, axis_name, "reduce")
+    if group is not None and dst not in group.ranks:
+        raise ValueError(f"reduce dst {dst} not in group {group.ranks}")
     reduced = all_reduce(x, op, axis_name, group=group)
     return jnp.where(lax.axis_index(axis_name) == dst, reduced, x)
 
@@ -190,6 +203,7 @@ def broadcast(
     With ``group``, only members receive src's value (src must be a
     member); non-members keep their input, matching torch semantics.
     """
+    _check_root(src, axis_name, "broadcast")
     contrib = jnp.where(lax.axis_index(axis_name) == src, x, jnp.zeros_like(x))
     value = lax.psum(contrib, axis_name)
     if group is None:
@@ -237,6 +251,7 @@ def gather(
     receive zeros (torch gives them nothing — SPMD outputs are uniform, so
     "nothing" is zeros).  With ``group``, non-member rows of dst's stack
     are zeroed and only the (member) dst receives anything."""
+    _check_root(dst, axis_name, "gather")
     stacked = lax.all_gather(x, axis_name, axis=0)
     if group is not None:
         if dst not in group.ranks:
@@ -368,12 +383,10 @@ def all_reduce_quantized(
     averaging, where that sits below gradient noise; use `all_reduce`
     where exactness matters.
     """
+    from tpu_dist.utils.tree import pad_to_multiple
+
     n = lax.axis_size(axis_name)
-    flat = x.reshape(-1)
-    pad = (-flat.shape[0]) % n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    chunks = flat.reshape(n, -1)  # chunk c destined for rank c
+    chunks = pad_to_multiple(x.reshape(-1), n).reshape(n, -1)  # chunk c -> rank c
     # Per-chunk symmetric quantization (one scale per destination chunk).
     scales = jnp.max(jnp.abs(chunks), axis=1) / 127.0 + 1e-30
     q = jnp.clip(

@@ -55,11 +55,7 @@ def ring_all_reduce(x: jax.Array, axis_name: str = DEFAULT_AXIS) -> jax.Array:
     return acc
 
 
-def _pad_to_multiple(flat: jax.Array, n: int) -> jax.Array:
-    pad = (-flat.shape[0]) % n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    return flat
+from tpu_dist.utils.tree import pad_to_multiple as _pad_to_multiple
 
 
 def ring_reduce_scatter(x: jax.Array, axis_name: str = DEFAULT_AXIS) -> jax.Array:

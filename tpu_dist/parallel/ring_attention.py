@@ -84,11 +84,7 @@ def ring_attention(
 
     local_pos = jnp.arange(s_local)
 
-    def step(carry, t):
-        m, l, acc, k_blk, v_blk = carry
-        # K/V blocks travel rank -> rank+1, so at step t we hold the block
-        # that originated at rank (r - t) mod n.
-        kv_rank = (r - t) % n
+    def block_step(m, l, acc, k_blk, v_blk, kv_rank):
         # MXU matmul in input precision; softmax bookkeeping in f32.
         logits = jnp.einsum(
             "...qd,...kd->...qk", qs, k_blk, preferred_element_type=jnp.float32
@@ -99,12 +95,26 @@ def ring_attention(
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = jnp.ones((s_local, s_local), bool)
-        m, l, acc = _block_update(m, l, acc, logits, v_blk, mask)
+        return _block_update(m, l, acc, logits, v_blk, mask)
+
+    # Local block first, then n-1 steps of (rotate, process): exactly
+    # 2(n-1) CollectivePermutes — rotating after the LAST block would ship
+    # a full K+V around the ring only to be discarded.
+    m, l, acc = block_step(m0, l0, acc0, k, v, r)
+
+    def step(carry, t):
+        m, l, acc, k_blk, v_blk = carry
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # after t+1 rotations we hold the block from rank (r - t - 1) mod n
+        kv_rank = (r - t - 1) % n
+        m, l, acc = block_step(m, l, acc, k_blk, v_blk, kv_rank)
         return (m, l, acc, k_blk, v_blk), None
 
-    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v), jnp.arange(n))
+    if n > 1:
+        (m, l, acc, _, _), _ = lax.scan(
+            step, (m, l, acc, k, v), jnp.arange(n - 1)
+        )
     return (acc / l[..., None]).astype(q.dtype)
 
 

@@ -164,6 +164,11 @@ def file_rendezvous(
         fcntl.flock(f, fcntl.LOCK_EX)
         try:
             table = read_table(f)
+            if len(table) >= world:
+                raise RuntimeError(
+                    f"file rendezvous: {path} already has {len(table)} "
+                    f"registrations for world {world} (stale file?)"
+                )
             if rank >= 0:
                 if rank in table:
                     raise RuntimeError(
@@ -173,13 +178,13 @@ def file_rendezvous(
                 my_rank = rank
             else:
                 my_rank = next(
-                    r for r in range(world) if r not in table
+                    (r for r in range(world) if r not in table), None
                 )
-            if len(table) >= world:
-                raise RuntimeError(
-                    f"file rendezvous: {path} already has {len(table)} "
-                    f"registrations for world {world} (stale file?)"
-                )
+                if my_rank is None:
+                    raise RuntimeError(
+                        f"file rendezvous: no free rank slot in {path} "
+                        f"for world {world} (stale file?)"
+                    )
             f.write(f"{my_rank} {payload}\n".encode())
             f.flush()
         finally:

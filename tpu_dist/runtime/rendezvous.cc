@@ -181,9 +181,12 @@ static int run_master(const char* addr, int port, int world,
     return -1;
   }
   if (bind(lfd, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+    bool in_use = errno == EADDRINUSE;
     set_err("bind");
     close(lfd);
-    return -1;
+    // -2 tells the rank-less caller "someone else is master — be a
+    // worker"; any other failure is terminal.
+    return in_use ? -2 : -1;
   }
   if (listen(lfd, world) < 0) {
     set_err("listen");
@@ -286,7 +289,18 @@ int td_rendezvous(const char* addr, int port, int world, int rank,
     return 0;
   }
   if (rank == 0) {
-    return run_master(addr, port, world, payload, timeout_ms, peers_out, cap);
+    int got = run_master(addr, port, world, payload, timeout_ms, peers_out, cap);
+    return got == -2 ? -1 : got;  // explicit rank 0 must own the port
+  }
+  if (rank < 0) {
+    // Rank-less (MPI-style) init: EVERY process races to become master by
+    // binding the port; exactly one bind succeeds (that process takes
+    // rank 0), the rest see EADDRINUSE (-2) and fall through to the
+    // worker path.  Without this election, an all-rank-less job would
+    // deadlock: no one would ever bind, and every worker would spin
+    // until timeout.
+    int got = run_master(addr, port, world, payload, timeout_ms, peers_out, cap);
+    if (got != -2) return got;
   }
   // Worker: retry connecting until the master is up (or timeout).
   timeval start{};

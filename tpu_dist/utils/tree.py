@@ -38,6 +38,15 @@ def tree_cast(tree, dtype):
     return jax.tree.map(lambda x: x.astype(dtype), tree)
 
 
+def pad_to_multiple(flat, n: int):
+    """Zero-pad a 1-D array so its length divides ``n`` (chunked
+    collectives: ring allreduce, quantized allreduce)."""
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat
+
+
 def stack_pytrees(trees):
     """Stack a list of same-structure pytrees on a new leading axis
     (e.g. per-stage or per-expert params, sharded over that axis when
