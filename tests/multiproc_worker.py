@@ -159,6 +159,14 @@ def main():
     )
     print("MULTIPROCESS TOPOLOGY-INVARIANCE OK")
 
+    # mpirun-style: no RANK env anywhere; ranks come from the bind-race
+    # election in the native rendezvous (this used to deadlock).
+    res = launch(psum_worker, world, platform="cpu",
+                 devices_per_proc=devices_per_proc, assign_ranks=False,
+                 timeout=120.0)
+    assert sorted(res) == expect, f"rank-less init: {res} != {expect}"
+    print("MULTIPROCESS RANKLESS OK", res)
+
     import tempfile
 
     with tempfile.TemporaryDirectory() as d:

@@ -30,7 +30,7 @@ from typing import Any, Callable
 
 
 def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc,
-           init_method=None):
+           init_method=None, assign_ranks=True):
     try:
         if init_method:
             os.environ["TPU_DIST_INIT_METHOD"] = init_method
@@ -41,7 +41,12 @@ def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc,
             os.environ["MASTER_ADDR"] = addr
             os.environ["MASTER_PORT"] = str(port)
         os.environ["WORLD_SIZE"] = str(world)
-        os.environ["RANK"] = str(rank)
+        if assign_ranks:
+            os.environ["RANK"] = str(rank)
+        else:
+            # mpirun-style: ranks come from the rendezvous master election
+            # (allreduce.py:54's rank-less init)
+            os.environ.pop("RANK", None)
         if platform == "cpu" and devices_per_proc:
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "")
@@ -69,14 +74,19 @@ def launch(
     devices_per_proc: int = 1,
     timeout: float = 300.0,
     init_method: str | None = None,
+    assign_ranks: bool = True,
 ) -> list[Any]:
     """Fork-join ``world`` processes running ``fn(rank, world)``.
 
     ``fn`` must be picklable (module-level).  Returns each rank's result,
-    index = rank.  Any child failure raises, fail-stop, after terminating
-    the others (the reference's failure model: blocked peers + ``join()``,
-    SURVEY.md §5).  ``init_method='file:///path'`` bootstraps through the
-    fcntl file rendezvous instead of the TCP master (tuto.md:430-437).
+    index = LAUNCH slot (== jax rank when ``assign_ranks``).  Any child
+    failure raises, fail-stop, after terminating the others (the
+    reference's failure model: blocked peers + ``join()``, SURVEY.md §5).
+    ``init_method='file:///path'`` bootstraps through the fcntl file
+    rendezvous instead of the TCP master (tuto.md:430-437).
+    ``assign_ranks=False`` leaves RANK unset — every child does the
+    MPI-style rank-less init and the rendezvous election assigns ranks
+    (allreduce.py:54 analog).
     """
     from tpu_dist import runtime
 
@@ -89,7 +99,7 @@ def launch(
         p = ctx.Process(
             target=_child,
             args=(fn, rank, world, addr, port, platform, child_conn,
-                  devices_per_proc, init_method),
+                  devices_per_proc, init_method, assign_ranks),
         )
         p.start()
         procs.append(p)
