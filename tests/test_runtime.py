@@ -126,17 +126,25 @@ class TestFileRendezvous:
     def test_duplicate_rank_raises(self, tmp_path):
         f = tmp_path / "rdzv"
         t = threading.Thread(
-            target=lambda: runtime.file_rendezvous(f, 2, 0, timeout_s=2.0)
+            target=lambda: runtime.file_rendezvous(f, 2, 0, timeout_s=10.0)
         )
         t.start()
         try:
             import time
 
-            time.sleep(0.2)  # let rank 0 register
+            # wait until rank 0's registration is actually on disk (a
+            # fixed sleep flakes under load)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if f.exists() and f.read_bytes().startswith(b"0 "):
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("rank 0 never registered")
             with pytest.raises(RuntimeError, match="already registered"):
                 runtime.file_rendezvous(f, 2, 0, timeout_s=1.0)
             # unblock the first thread
-            runtime.file_rendezvous(f, 2, 1, timeout_s=2.0)
+            runtime.file_rendezvous(f, 2, 1, timeout_s=10.0)
         finally:
             t.join()
 

@@ -292,27 +292,30 @@ int td_rendezvous(const char* addr, int port, int world, int rank,
     int got = run_master(addr, port, world, payload, timeout_ms, peers_out, cap);
     return got == -2 ? -1 : got;  // explicit rank 0 must own the port
   }
-  if (rank < 0) {
-    // Rank-less (MPI-style) init: EVERY process races to become master by
-    // binding the port; exactly one bind succeeds (that process takes
-    // rank 0), the rest see EADDRINUSE (-2) and fall through to the
-    // worker path.  Without this election, an all-rank-less job would
-    // deadlock: no one would ever bind, and every worker would spin
-    // until timeout.
-    int got = run_master(addr, port, world, payload, timeout_ms, peers_out, cap);
-    if (got != -2) return got;
-  }
   // Worker: retry connecting until the master is up (or timeout).
+  // Rank-less (MPI-style) processes additionally ELECT a master if none
+  // appears: after a short grace period (which lets an explicit rank-0,
+  // if one exists, bind first — no race in mixed launches), they compete
+  // to bind the port; exactly one wins and becomes rank 0, the rest see
+  // EADDRINUSE and keep connecting.  Without the election an
+  // all-rank-less job would deadlock with every process waiting for a
+  // master nobody becomes.
   timeval start{};
   gettimeofday(&start, nullptr);
+  long grace_ms = timeout_ms / 4 < 1000 ? timeout_ms / 4 : 1000;
   int fd = -1;
   for (;;) {
-    fd = connect_to(addr, port, timeout_ms);
+    fd = connect_to(addr, port, 200);
     if (fd >= 0) break;
     timeval now{};
     gettimeofday(&now, nullptr);
     long elapsed_ms = (now.tv_sec - start.tv_sec) * 1000 +
                       (now.tv_usec - start.tv_usec) / 1000;
+    if (rank < 0 && elapsed_ms > grace_ms) {
+      int got =
+          run_master(addr, port, world, payload, timeout_ms, peers_out, cap);
+      if (got != -2) return got;  // won the election (or terminal error)
+    }
     if (elapsed_ms > timeout_ms) {
       set_errmsg("worker: master did not come up before timeout");
       return -1;
