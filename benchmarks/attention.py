@@ -42,13 +42,9 @@ def main():
     ap.add_argument("--causal", action="store_true")
     args = ap.parse_args()
     if args.platform == "cpu":
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.world}"
-        )
-        import jax
+        from tpu_dist.utils.platform import pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+        pin_cpu(args.world)
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P

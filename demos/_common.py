@@ -34,11 +34,7 @@ def parse_args(default_world: int | None = None, **extra):
     args = parser.parse_args()
     if args.platform == "cpu":
         # Simulated multi-device CPU mesh (must precede backend init).
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.world or 8}"
-        )
-        import jax
+        from tpu_dist.utils.platform import pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+        pin_cpu(args.world or 8)
     return args

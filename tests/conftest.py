@@ -12,24 +12,23 @@ they never touch a real TPU (which may be a slow tunnel in CI).
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
 os.environ.setdefault("TPU_DIST_PLATFORM", "cpu")
+
+# Restrict JAX to the CPU platform with 8 simulated devices: initializing
+# the TPU backend in a test run is both slow (tunneled) and unnecessary,
+# and the axon shim ignores the JAX_PLATFORMS env var — pin_cpu's config
+# override wins because no backend is initialized yet at conftest-import
+# time.  TPU_DIST_TEST_TPU=1 leaves the real backend available for the
+# tpu-marked hardware tests (run those as:
+#   TPU_DIST_TEST_TPU=1 pytest tests/test_tpu_hardware.py -m tpu
+# — the 8 simulated CPU devices are still provisioned alongside).
+from tpu_dist.utils.platform import pin_cpu  # noqa: E402
+
+pin_cpu(8, opt_out_env="TPU_DIST_TEST_TPU")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
-# Restrict JAX to the CPU platform entirely: initializing the TPU backend
-# in a test run is both slow (tunneled) and unnecessary, and the axon shim
-# ignores the JAX_PLATFORMS env var (it rewrites platform selection at
-# interpreter startup) — the config override below still wins because no
-# backend has been initialized yet at conftest-import time.
-# TPU_DIST_TEST_TPU=1 leaves the real backend available for the
-# tpu-marked hardware tests (run those as:
-#   TPU_DIST_TEST_TPU=1 pytest tests/test_tpu_hardware.py -m tpu).
-if os.environ.get("TPU_DIST_TEST_TPU") != "1":
-    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 

@@ -5,6 +5,7 @@ from tpu_dist.utils.debug import (
     blocked_until_ready,
     collective_watchdog,
 )
+from tpu_dist.utils.platform import pin_cpu
 from tpu_dist.utils.tree import (
     global_norm,
     tree_allclose,
@@ -18,6 +19,7 @@ __all__ = [
     "blocked_until_ready",
     "collective_watchdog",
     "global_norm",
+    "pin_cpu",
     "tree_allclose",
     "tree_bytes",
     "tree_cast",

@@ -1,0 +1,65 @@
+"""Platform pinning: keep flaky TPU backends out of CPU-sim runs.
+
+The reference simulates a cluster with loopback process forks
+(train_dist.py:138-147); our analog is N simulated XLA host devices in
+one process.  Getting that requires two env mutations **before JAX
+initializes its backends** — and in containers where the TPU is behind a
+tunnel, touching the default backend at all can hang indefinitely.  This
+is the shared implementation of that sequence for every entry point
+(conftest, bench, demos, benchmarks, __graft_entry__).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+
+def pin_cpu(n_devices: int | None = None, *, opt_out_env: str | None = None) -> bool:
+    """Restrict this process to the CPU platform, simulating ``n_devices``
+    host devices, and VERIFY the pin took effect.
+
+    Must run before JAX backend init (importing jax is fine).  The
+    device-count flag is appended unconditionally — with duplicate XLA
+    flags the last one wins, so a stale smaller value in the inherited
+    environment is overridden rather than silently kept — and it is
+    appended even under the opt-out (it only affects the CPU platform,
+    and real-hardware test runs still want simulated CPU devices
+    alongside the real chips).
+
+    Returns True if the process is now pinned to ≥``n_devices`` CPU
+    devices.  Returns False — with a RuntimeWarning — when the pin had no
+    effect (JAX backend was already initialized, in which case both the
+    platform pin and the device count are silently ignored by JAX), and
+    False silently when ``opt_out_env`` is "1" (real-hardware opt-in,
+    e.g. TPU_DIST_TEST_TPU / TPU_DIST_ENTRY_TPU).
+    """
+    if n_devices:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        )
+    if opt_out_env and os.environ.get(opt_out_env) == "1":
+        return False
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # some versions raise post-init; the check below decides
+    # The update is a silent no-op once backends exist — verify.  (This
+    # initializes the CPU backend, which is cheap, local, and exactly the
+    # state every caller wants next.)
+    devs = jax.devices()
+    if devs and devs[0].platform == "cpu" and (
+        not n_devices or len(devs) >= n_devices
+    ):
+        return True
+    warnings.warn(
+        f"pin_cpu({n_devices}) had no effect: JAX backend already "
+        f"initialized with {len(devs)} {devs[0].platform if devs else '?'} "
+        f"device(s) — call pin_cpu before any jax.devices()/jit use",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+    return False
