@@ -84,7 +84,19 @@ def measure(
         p, ms, os_, loss, _ = step(p, ms, os_, batch, key)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    return steps * global_batch / dt
+    sps = steps * global_batch / dt
+
+    # XLA cost analysis reports the PER-DEVICE partitioned program, so
+    # per-device flops vs one chip's peak is the per-chip MFU (== world
+    # MFU for even SPMD sharding); the world-total TFLOP/s scales by N.
+    per_dev_flops = train.flops.xla_flops(step, p, ms, os_, batch, key)
+    util = train.flops.mfu(
+        per_dev_flops, dt / steps, n_devices=1, device=mesh.devices.flat[0]
+    )
+    tflops = (
+        per_dev_flops * world / (dt / steps) / 1e12 if per_dev_flops else None
+    )
+    return sps, tflops, util
 
 
 def main():
@@ -96,13 +108,9 @@ def main():
     ap.add_argument("--model", default="mnist", help="mnist | resnet18 | vit")
     args = ap.parse_args()
     if args.platform == "cpu":
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.max_world or 8}"
-        )
-        import jax
+        from tpu_dist.utils.platform import pin_cpu
 
-        jax.config.update("jax_platforms", "cpu")
+        pin_cpu(args.max_world or 8)
     import jax
 
     n_dev = len(jax.devices(args.platform) if args.platform else jax.devices())
@@ -110,13 +118,17 @@ def main():
     worlds = [w for w in (1, 2, 4, 8, 16, 32) if w <= max_world]
 
     results = {}
+    stats = {}
     for w in worlds:
-        sps = measure(w, args.batch_per_chip, args.steps, args.platform,
-                      model_name=args.model)
+        sps, tflops, util = measure(w, args.batch_per_chip, args.steps,
+                                    args.platform, model_name=args.model)
         results[w] = sps
+        stats[w] = (tflops, util)
         print(
             f"world={w:3d}  {sps:12,.0f} samples/s  "
-            f"({sps / w:10,.0f} /chip)",
+            f"({sps / w:10,.0f} /chip)"
+            + (f"  {tflops:8.3f} TFLOP/s" if tflops else "")
+            + (f"  MFU {util:6.2%}" if util is not None else ""),
             file=sys.stderr,
         )
     base = results[worlds[0]]
@@ -124,6 +136,8 @@ def main():
         str(w): {
             "samples_per_sec": round(results[w], 1),
             "efficiency": round(results[w] / (base * w / worlds[0]), 4),
+            "tflops": round(stats[w][0], 4) if stats[w][0] else None,
+            "mfu": round(stats[w][1], 4) if stats[w][1] is not None else None,
         }
         for w in worlds
     }

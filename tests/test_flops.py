@@ -1,0 +1,68 @@
+"""FLOPs counters + MFU: analytic vs XLA-cost-analysis cross-check.
+
+The analytic counters give the conventional "model FLOPs" numerator;
+XLA's cost analysis counts the whole compiled program.  On the forward
+pass the two must agree to within the elementwise noise floor.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_dist import models
+from tpu_dist.train import flops
+
+
+def test_mnist_analytic_value():
+    # conv1 288k + conv2 640k + fc1 32k + fc2 1k per sample
+    assert flops.mnist_net_forward_flops(1) == pytest.approx(961_000.0)
+    assert flops.mnist_net_forward_flops(8) == pytest.approx(8 * 961_000.0)
+
+
+def test_xla_forward_matches_analytic():
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+    batch = 16
+
+    def fwd(p, x):
+        scores, _ = model.apply(p, state, x, train=False)
+        return scores
+
+    x = jnp.zeros((batch,) + models.IN_SHAPE, jnp.float32)
+    measured = flops.xla_flops(fwd, params, x)
+    assert measured is not None, "CPU cost analysis should report flops"
+    analytic = flops.mnist_net_forward_flops(batch)
+    # matmul/conv math dominates; XLA adds elementwise/pooling on top.
+    assert analytic * 0.9 <= measured <= analytic * 2.0, (measured, analytic)
+
+
+def test_train_step_estimate_and_mfu_math():
+    fwd = flops.mnist_net_forward_flops(128)
+    assert flops.train_step_flops_estimate(fwd) == pytest.approx(3 * fwd)
+
+    class FakeDev:
+        device_kind = "TPU v5 lite"
+        platform = "tpu"
+
+    # 1e12 flops in 10ms on one 197-TFLOP/s chip -> 1e14/1.97e14
+    util = flops.mfu(1e12, 0.01, device=FakeDev())
+    assert util == pytest.approx(1e14 / 197e12)
+    # unknown platform (CPU-sim) -> None, not a bogus number
+    assert flops.peak_flops(jax.devices("cpu")[0]) is None
+    assert flops.mfu(1e12, 0.01, device=jax.devices("cpu")[0]) is None
+    assert flops.mfu(None, 0.01) is None
+
+
+def test_attention_flops_causal_fraction():
+    full = flops.attention_flops(2, 4, 128, 128, 64)
+    assert full == pytest.approx(2 * 2 * 4 * 128 * 128 * 64 * 2)
+    # self-attention: realizable lower triangle incl. diagonal =
+    # (s^2 - s(s-1)/2)/s^2 = (s+1)/(2s)
+    s = 128
+    assert flops.attention_flops(2, 4, s, s, 64, causal=True) == pytest.approx(
+        full * (s + 1) / (2 * s)
+    )
+    # decode-style sq=1: the single suffix query sees ALL keys — no
+    # causal discount (halving here would undercount 2x)
+    one = flops.attention_flops(1, 1, 1, 4096, 64)
+    assert flops.attention_flops(1, 1, 1, 4096, 64, causal=True) == one

@@ -1,6 +1,6 @@
 """`tpu_dist.train` — optimizers, trainer, checkpointing, metrics."""
 
-from tpu_dist.train import checkpoint, metrics, schedule
+from tpu_dist.train import checkpoint, flops, metrics, schedule
 from tpu_dist.train.optim import Optimizer, adamw, sgd
 from tpu_dist.train.trainer import EpochStats, TrainConfig, Trainer
 
@@ -11,6 +11,7 @@ __all__ = [
     "Trainer",
     "adamw",
     "checkpoint",
+    "flops",
     "metrics",
     "schedule",
     "sgd",
