@@ -30,6 +30,23 @@ class TestInitConfig:
         assert cfg.num_processes is None
         assert cfg.process_id is None
 
+    def test_file_init_rejects_multihost_master_addr(self, monkeypatch, tmp_path):
+        # file:// rendezvous publishes a loopback coordinator, so an
+        # off-host MASTER_ADDR signals a job it cannot serve: fail at
+        # bootstrap, not as a later jax.distributed hang.
+        import pytest
+
+        # TEST-NET-3 address: guaranteed to resolve off-host everywhere
+        monkeypatch.setenv("MASTER_ADDR", "203.0.113.7")
+        monkeypatch.delenv("MASTER_PORT", raising=False)
+        monkeypatch.setenv("TPU_DIST_INIT_METHOD", f"file://{tmp_path}/rdzv")
+        import importlib
+
+        init_mod = importlib.import_module("tpu_dist.comm.init")
+        monkeypatch.setattr(init_mod, "_initialized", False)
+        with pytest.raises(ValueError, match="single-host only"):
+            comm.init(num_processes=2, process_id=0)
+
     def test_addr_without_port_ignored(self, monkeypatch):
         monkeypatch.setenv("MASTER_ADDR", "10.0.0.1")
         monkeypatch.delenv("MASTER_PORT", raising=False)

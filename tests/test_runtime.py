@@ -123,6 +123,13 @@ class TestFileRendezvous:
         with pytest.raises(RuntimeError, match="before timeout"):
             runtime.file_rendezvous(tmp_path / "rdzv", 2, 0, timeout_s=0.3)
 
+    def test_rank_out_of_range_raises(self, tmp_path):
+        # RANK=5 with WORLD_SIZE=2 must fail at bootstrap, not surface
+        # later as a confusing jax.distributed error (mirrors the TCP
+        # path's run_master range check)
+        with pytest.raises(RuntimeError, match="out of range"):
+            runtime.file_rendezvous(tmp_path / "rdzv", 2, 5, timeout_s=1.0)
+
     def test_duplicate_rank_raises(self, tmp_path):
         f = tmp_path / "rdzv"
         t = threading.Thread(

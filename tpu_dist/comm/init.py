@@ -51,6 +51,49 @@ class InitConfig:
         )
 
 
+def _addr_is_remote(addr: str) -> bool:
+    """True only when ``addr`` definitely names another machine: not
+    loopback, not this hostname, and not resolving to any of this host's
+    addresses.  Unresolvable addresses are treated as local-unknown
+    (warn-free pass) — a guard must not produce false positives."""
+    import socket
+
+    if addr in ("127.0.0.1", "localhost", "::1") or addr == socket.gethostname():
+        return False
+    try:
+        target = {ai[4][0] for ai in socket.getaddrinfo(addr, None)}
+    except OSError:
+        return False
+    if any(ip.startswith("127.") or ip == "::1" for ip in target):
+        return False
+    try:
+        local = {
+            ai[4][0] for ai in socket.getaddrinfo(socket.gethostname(), None)
+        }
+    except OSError:
+        local = set()
+    if target & local:
+        return False
+    # gethostname() may only map to loopback (Debian-style 127.0.1.1
+    # /etc/hosts) while MASTER_ADDR carries the real interface IP: the
+    # source address the kernel would route FROM to reach the target is
+    # the target itself iff the target is one of our interfaces.  (UDP
+    # connect assigns a route without sending any packet.)
+    for ip in target:
+        fam = socket.AF_INET6 if ":" in ip else socket.AF_INET
+        try:
+            s = socket.socket(fam, socket.SOCK_DGRAM)
+            try:
+                s.connect((ip, 9))
+                if s.getsockname()[0] == ip:
+                    return False
+            finally:
+                s.close()
+        except OSError:
+            continue
+    return True
+
+
 _initialized = False
 
 
@@ -102,6 +145,21 @@ def init(
             # rank 0 publishes the JAX coordinator address as its payload
             # (every payload carries a candidate; rank 0's wins).
             path = init_method[len("file://"):]
+            # file:// rendezvous is single-host only (fcntl on a local
+            # file; the published coordinator is loopback).  A MASTER_ADDR
+            # that resolves OFF this host signals a multi-host job this
+            # init method cannot serve — fail fast instead of hanging
+            # later in jax.distributed.initialize.  Launchers that export
+            # the local host's own IP/hostname (SLURM-style boilerplate)
+            # are legitimately single-host and pass.
+            master = os.environ.get("MASTER_ADDR")
+            if master and _addr_is_remote(master):
+                raise ValueError(
+                    f"TPU_DIST_INIT_METHOD=file:// is single-host only "
+                    f"(loopback coordinator), but MASTER_ADDR={master!r} "
+                    f"resolves off this host — use the TCP init path "
+                    f"(tuto.md:421-428 contract) instead"
+                )
             candidate = f"127.0.0.1:{runtime.free_port()}"
             my_rank, peers = runtime.file_rendezvous(
                 path, cfg.num_processes, rank, payload=candidate

@@ -26,6 +26,12 @@ def dot_product_attention(
 ) -> jax.Array:
     """Softmax attention. Shapes: (..., heads, seq, head_dim).
 
+    ``causal`` with unequal query/key lengths uses BOTTOM-RIGHT (suffix)
+    alignment: the queries are taken to be the last ``sq`` positions of
+    the ``sk``-long key sequence (tril offset ``sk - sq``) — the
+    decode-style convention flash-attention implementations use.  For any
+    other cross-attention alignment, build the mask yourself.
+
     With ``TPU_DIST_FLASH=1`` the blockwise Pallas kernel
     (`tpu_dist.ops.flash_attention`) takes over for sequences past its
     block size — no (S, S) materialization; numerics match to fp

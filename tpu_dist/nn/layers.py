@@ -219,7 +219,13 @@ class Dropout2D(Module):
 
 class BatchNorm(Module):
     """Batch normalization with running statistics carried in ``state``
-    (ResNet-18 needs it; the reference's MNIST net does not use BN)."""
+    (ResNet-18 needs it; the reference's MNIST net does not use BN).
+
+    ``momentum`` is the DECAY of the running average (Flax convention):
+    ``running = momentum * running + (1 - momentum) * batch_stat``.
+    torch's ``nn.BatchNorm2d(momentum=m)`` corresponds to ``1 - m`` here —
+    torch's default 0.1 equals this default of 0.9; do not pass torch's
+    value through unchanged."""
 
     def __init__(self, momentum: float = 0.9, eps: float = 1e-5):
         self.momentum = momentum

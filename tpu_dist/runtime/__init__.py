@@ -170,6 +170,11 @@ def file_rendezvous(
                     f"registrations for world {world} (stale file?)"
                 )
             if rank >= 0:
+                if rank >= world:
+                    raise RuntimeError(
+                        f"file rendezvous: rank {rank} out of range for "
+                        f"world {world}"
+                    )
                 if rank in table:
                     raise RuntimeError(
                         f"file rendezvous: rank {rank} already registered "

@@ -198,7 +198,11 @@ class Trainer:
                     axis_name=self.mesh.axis_names[0],
                 )
                 for bi, batch in enumerate(batches):
-                    key = jax.random.fold_in(step_key, epoch * 100000 + bi)
+                    # fold epoch and batch index separately: no collisions
+                    # however many steps an epoch has
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(step_key, epoch), bi
+                    )
                     (
                         self.params,
                         self.model_state,
