@@ -49,10 +49,15 @@ class Group:
     """A communication sub-group — ``dist.new_group([ranks])`` analog.
 
     The reference builds groups as subsets of WORLD (tuto.md:178-186).
-    XLA's ``axis_index_groups`` requires equal-size groups partitioning the
-    axis, so arbitrary subsets use a gather-and-mask path instead: members
-    reduce over member contributions only; non-members pass their input
-    through unchanged (matching torch, where non-members don't participate).
+    Semantics everywhere: members communicate among themselves only;
+    non-members pass their input through unchanged (matching torch, where
+    non-members don't participate).  Reductions (all_reduce SUM/MAX/MIN,
+    reduce, broadcast) lower to a NATIVE grouped AllReduce — the group
+    plus one singleton per non-member is a valid unequal-size
+    ``axis_index_groups`` partition, so wire traffic is O(group).  Only
+    PRODUCT (no XLA reduce primitive) and the shape-changing collectives
+    (gather/scatter/all_gather, whose grouped XLA forms require
+    equal-size groups) use an all-gather + mask path.
     """
 
     ranks: tuple[int, ...]
@@ -128,8 +133,12 @@ def all_reduce(
 ) -> jax.Array:
     """``dist.all_reduce(tensor, op, group)`` (tuto.md:182-186).
 
-    WORLD reductions lower directly to XLA AllReduce (psum/pmax/pmin);
-    PRODUCT (no XLA primitive) and sub-group reductions take an
+    WORLD reductions lower directly to XLA AllReduce (psum/pmax/pmin),
+    and so do sub-group SUM/MAX/MIN: the group plus one singleton per
+    non-member is a valid (unequal-size) ``axis_index_groups`` partition —
+    members reduce over the group while each singleton's "reduction" is
+    its own input, which IS torch's non-member passthrough.  Wire traffic
+    stays O(group), not O(world).  PRODUCT (no XLA primitive) takes an
     all-gather + on-device reduction.  Known answer: all_reduce of ones
     over n ranks with SUM prints n (tuto.md:184-185).
     """
@@ -147,11 +156,28 @@ def all_reduce(
         raise ValueError(
             f"group ranks {group.ranks} out of range for world size {n}"
         )
+    if not group.ranks:
+        return x
+    if op is not ReduceOp.PRODUCT:
+        groups = _group_partition(group, n)
+        if op is ReduceOp.SUM:
+            return lax.psum(x, axis_name, axis_index_groups=groups)
+        if op is ReduceOp.MAX:
+            return lax.pmax(x, axis_name, axis_index_groups=groups)
+        return lax.pmin(x, axis_name, axis_index_groups=groups)
     stacked = lax.all_gather(x, axis_name, axis=0)
     mask = group.mask(n).reshape((n,) + (1,) * x.ndim)
     ident = _masked_identity(op, stacked.dtype)
     reduced = _reduce_stacked(jnp.where(mask, stacked, ident), op)
     return jnp.where(group.is_member(axis_name), reduced, x)
+
+
+def _group_partition(group: Group, n: int) -> list[list[int]]:
+    """``axis_index_groups`` partition for a sub-group collective: the
+    group itself + a singleton per non-member (XLA allows unequal-size
+    AllReduce replica groups; a singleton reduction is passthrough)."""
+    members = set(group.ranks)
+    return [list(group.ranks)] + [[r] for r in range(n) if r not in members]
 
 
 def _check_root(root: int, axis_name: str, what: str) -> None:
@@ -205,11 +231,17 @@ def broadcast(
     """
     _check_root(src, axis_name, "broadcast")
     contrib = jnp.where(lax.axis_index(axis_name) == src, x, jnp.zeros_like(x))
-    value = lax.psum(contrib, axis_name)
     if group is None:
-        return value
+        return lax.psum(contrib, axis_name)
     if src not in group.ranks:
         raise ValueError(f"broadcast src {src} not in group {group.ranks}")
+    # Grouped AllReduce keeps the multicast on group members' wires only;
+    # each non-member singleton just gets its own (masked) contribution
+    # back, replaced by its input in the final select.
+    value = lax.psum(
+        contrib, axis_name,
+        axis_index_groups=_group_partition(group, lax.axis_size(axis_name)),
+    )
     return jnp.where(group.is_member(axis_name), value, x)
 
 
