@@ -148,3 +148,113 @@ def test_indivisible_microbatches_raise():
 
     with pytest.raises(ValueError, match="not divisible"):
         run(fn, stacked, x, world=N)
+
+
+class TestInterleaved:
+    """Interleaved (1F1B-style) schedule: v chunks per rank — values and
+    grads match sequential; bubble accounting beats GPipe."""
+
+    V = 2  # chunks per rank -> N*V global stages
+
+    def _chunk_nest(self, key):
+        # [rank][chunk] params; chunk c on rank s = global stage c*N + s
+        stages = _make_stage_params(key, n_stages=N * self.V)
+        return [[stages[c * N + s] for c in range(self.V)] for s in range(N)], stages
+
+    @pytest.mark.parametrize("n_micro", [4, 8])
+    def test_matches_sequential(self, n_micro):
+        nest, stages = self._chunk_nest(jax.random.key(10))
+        x = jax.random.normal(jax.random.key(11), (16, D))
+        expect = _sequential(stages, x)
+        stacked = parallel.stack_chunk_params(nest)
+
+        def fn(stacked, x):
+            r = comm.rank()
+            chunks_local = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(t, r, 0, keepdims=False),
+                stacked,
+            )
+            return parallel.pipeline_apply_interleaved(
+                _stage_fn, chunks_local, x,
+                n_microbatches=n_micro, axis_name=comm.DEFAULT_AXIS,
+            )
+
+        out = np.asarray(run(fn, stacked, x, world=N))
+        for r in range(N):
+            np.testing.assert_allclose(
+                out[r], np.asarray(expect), rtol=1e-5, atol=1e-6
+            )
+
+    def test_differentiates_matches_sequential(self):
+        nest, stages = self._chunk_nest(jax.random.key(12))
+        x = jax.random.normal(jax.random.key(13), (8, D))
+        stacked = parallel.stack_chunk_params(nest)
+
+        def seq_loss(stacked):
+            # walk global stage order c*N + s through the (rank, chunk) nest
+            y = x
+            for g in range(N * self.V):
+                c, s = divmod(g, N)
+                p = jax.tree.map(lambda t: t[s, c], stacked)
+                y = _stage_fn(p, y)
+            return jnp.sum(y**2)
+
+        g_seq = jax.grad(seq_loss)(stacked)
+
+        def fn(stacked, x):
+            r = comm.rank()
+
+            def loss(stacked):
+                chunks_local = jax.tree.map(
+                    lambda t: jax.lax.dynamic_index_in_dim(
+                        t, r, 0, keepdims=False
+                    ),
+                    stacked,
+                )
+                y = parallel.pipeline_apply_interleaved(
+                    _stage_fn, chunks_local, x,
+                    n_microbatches=4, axis_name=comm.DEFAULT_AXIS,
+                )
+                return jnp.sum(y**2)
+
+            return jax.grad(loss)(stacked)
+
+        out = run(fn, stacked, x, world=N)
+        for key in ("w", "b"):
+            total = np.asarray(out[key]).sum(axis=0)
+            np.testing.assert_allclose(
+                total, np.asarray(g_seq[key]), rtol=1e-4, atol=1e-5
+            )
+
+    def test_bubble_fraction_below_gpipe(self):
+        # the done-criterion: measurable step-count win over GPipe
+        for n, M in ((4, 8), (8, 16), (4, 4)):
+            gp = parallel.gpipe_bubble_fraction(n, M)
+            for v in (2, 4):
+                il = parallel.interleaved_bubble_fraction(n, M, v)
+                assert il < gp, (n, M, v, il, gp)
+        # v=1 degenerates to GPipe exactly
+        assert parallel.interleaved_bubble_fraction(4, 8, 1) == (
+            parallel.gpipe_bubble_fraction(4, 8)
+        )
+        assert parallel.interleaved_ticks(4, 8, 1) == parallel.gpipe_ticks(4, 8)
+
+    def test_microbatch_round_constraint(self):
+        nest, _ = self._chunk_nest(jax.random.key(14))
+        stacked = parallel.stack_chunk_params(nest)
+        x = jnp.ones((12, D))
+
+        def fn(stacked, x):
+            r = comm.rank()
+            chunks_local = jax.tree.map(
+                lambda t: jax.lax.dynamic_index_in_dim(t, r, 0, keepdims=False),
+                stacked,
+            )
+            return parallel.pipeline_apply_interleaved(
+                _stage_fn, chunks_local, x,
+                n_microbatches=6,  # not a multiple of N=4
+                axis_name=comm.DEFAULT_AXIS,
+            )
+
+        with pytest.raises(ValueError, match="multiple of the"):
+            run(fn, stacked, x, world=N)

@@ -25,7 +25,13 @@ from tpu_dist.parallel.moe import (
 )
 from tpu_dist.parallel.pipeline import (
     PIPE_AXIS,
+    gpipe_bubble_fraction,
+    gpipe_ticks,
+    interleaved_bubble_fraction,
+    interleaved_ticks,
     pipeline_apply,
+    pipeline_apply_interleaved,
+    stack_chunk_params,
     stack_stage_params,
 )
 from tpu_dist.parallel.ulysses import ulysses_attention
@@ -48,8 +54,14 @@ __all__ = [
     "EXPERT_AXIS",
     "MODEL_AXIS",
     "PIPE_AXIS",
+    "gpipe_bubble_fraction",
+    "gpipe_ticks",
+    "interleaved_bubble_fraction",
+    "interleaved_ticks",
     "moe_mlp",
     "pipeline_apply",
+    "pipeline_apply_interleaved",
+    "stack_chunk_params",
     "stack_expert_params",
     "stack_stage_params",
     "RingMultiHeadAttention",

@@ -123,3 +123,147 @@ def pipeline_apply(
     # grads through pipeline_apply equal sequential-execution grads.
     outputs = outputs / n + lax.stop_gradient(outputs * (n - 1) / n)
     return outputs.reshape((B,) + x.shape[1:])
+
+
+def gpipe_ticks(n: int, n_microbatches: int) -> int:
+    """GPipe schedule length in full-stage ticks."""
+    return n_microbatches + n - 1
+
+
+def gpipe_bubble_fraction(n: int, n_microbatches: int) -> float:
+    """Idle fraction of the GPipe schedule: (n-1)/(M+n-1)."""
+    return (n - 1) / gpipe_ticks(n, n_microbatches)
+
+
+def interleaved_ticks(n: int, n_microbatches: int, n_chunks: int) -> int:
+    """Interleaved schedule length in CHUNK ticks (each 1/n_chunks of a
+    full per-rank stage): M·v + n - 1."""
+    return n_microbatches * n_chunks + n - 1
+
+
+def interleaved_bubble_fraction(
+    n: int, n_microbatches: int, n_chunks: int
+) -> float:
+    """Idle fraction of the interleaved schedule: (n-1)/(M·v+n-1).
+
+    Each of the M·v work ticks is 1/v of a full stage, so the n-1 drain
+    ticks shrink relative to the work — the Megatron interleaving win.
+    Strictly below `gpipe_bubble_fraction` for v > 1.
+    """
+    return (n - 1) / interleaved_ticks(n, n_microbatches, n_chunks)
+
+
+def stack_chunk_params(chunk_params_per_rank: list[list[Any]]) -> Any:
+    """Stack a [rank][chunk] params nest for the interleaved schedule:
+    leading axes (n_ranks, n_chunks); shard with ``P('pipe')`` so each
+    rank's local slice carries its n_chunks chunk-parameter pytrees.
+
+    Chunk c on rank s implements GLOBAL stage ``c·n + s`` (Megatron
+    interleaved assignment): rank s holds stages s, n+s, 2n+s, ...
+    """
+    from tpu_dist.utils.tree import stack_pytrees
+
+    return stack_pytrees(
+        [stack_pytrees(chunks) for chunks in chunk_params_per_rank]
+    )
+
+
+def pipeline_apply_interleaved(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    chunks_local: Any,
+    x: jax.Array,
+    *,
+    n_microbatches: int,
+    axis_name: str = PIPE_AXIS,
+    remat_stages: bool = False,
+) -> jax.Array:
+    """Interleaved (Megatron 1F1B-style) pipeline schedule.
+
+    Each rank holds ``v`` model CHUNKS (virtual stages) instead of one:
+    chunk ``c`` on rank ``s`` is global stage ``c·n + s``, so activations
+    still only ever hop to the right neighbor (the chunk boundary
+    ``c·n - 1 → c·n`` is the wrap-around hop ``n-1 → 0``).  Microbatches
+    are processed in rounds of ``n``: within round ``r``, chunk-stage
+    ``g = c·n + s`` runs microbatch ``m = r·n + j`` at tick
+    ``r·n·v + c·n + j + s``.  Every rank does exactly one chunk per tick
+    (1/v of a GPipe tick), giving ``M·v + n - 1`` chunk-ticks total and
+    bubble fraction ``(n-1)/(M·v+n-1)`` — below GPipe's ``(n-1)/(M+n-1)``
+    for v > 1 (see `interleaved_bubble_fraction`).
+
+    Args:
+      stage_fn: ``(chunk_params, activation) -> activation``; uniform
+        activation shapes across all ``n·v`` chunk-stages.
+      chunks_local: this rank's stacked chunk parameters — inside
+        shard_map, the local slice of `stack_chunk_params` output with the
+        rank axis (size 1) squeezed, leaving a leading ``v`` axis.
+      x: full local batch ``(B, ...)``, replicated; split into
+        ``n_microbatches`` microbatches.  ``n_microbatches`` must be a
+        multiple of the pipe world (rounds of n — Megatron's constraint)
+        and divide B.
+
+    Forward-only scheduling like `pipeline_apply`; pure JAX, so the
+    backward replays the scan in reverse and grads match sequential
+    execution (tested), the 1F1B memory shape coming from
+    ``remat_stages=True``.
+    """
+    n = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    v = jax.tree.leaves(chunks_local)[0].shape[0]
+    B = x.shape[0]
+    if B % n_microbatches:
+        raise ValueError(
+            f"batch {B} not divisible by n_microbatches {n_microbatches}"
+        )
+    if n_microbatches % n:
+        raise ValueError(
+            f"n_microbatches {n_microbatches} must be a multiple of the "
+            f"pipe world {n} (rounds of n)"
+        )
+    mb = B // n_microbatches
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+    perm = ring_perm(n)
+    ticks = interleaved_ticks(n, n_microbatches, v)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # This rank's schedule position: t' = t - s, decomposed into
+        # (round r, chunk c, offset j) with t' = r·n·v + c·n + j.
+        tp = t - s
+        active = (tp >= 0) & (tp < n_microbatches * v)
+        tp_c = jnp.clip(tp, 0, n_microbatches * v - 1)
+        r = tp_c // (n * v)
+        rem = tp_c % (n * v)
+        c = rem // n
+        j = rem % n
+        m = jnp.clip(r * n + j, 0, n_microbatches - 1)
+
+        chunk_params = jax.tree.map(
+            lambda p: lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+            chunks_local,
+        )
+        # Global stage c·n + s == 0 (rank 0, chunk 0) injects microbatch m;
+        # everything else consumes the right-flowing neighbor hand-off.
+        injected = lax.dynamic_index_in_dim(micro, m, 0, keepdims=False)
+        x_in = jnp.where((s == 0) & (c == 0), injected, buf)
+        y = stage_fn(chunk_params, x_in)
+        # Global last stage (rank n-1, chunk v-1) banks microbatch m.
+        valid_out = active & (s == n - 1) & (c == v - 1)
+        prev = lax.dynamic_index_in_dim(outputs, m, 0, keepdims=False)
+        updated = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid_out, y, prev), m, 0
+        )
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, updated), None
+
+    init = (
+        jnp.zeros((mb,) + x.shape[1:], x.dtype),
+        jnp.zeros_like(micro),
+    )
+    (_, outputs), _ = lax.scan(tick, init, jnp.arange(ticks))
+    outputs = jnp.where(s == n - 1, outputs, jnp.zeros_like(outputs))
+    outputs = lax.psum(outputs, axis_name)
+    # Same replicated-cotangent correction as `pipeline_apply`.
+    outputs = outputs / n + lax.stop_gradient(outputs * (n - 1) / n)
+    return outputs.reshape((B,) + x.shape[1:])
