@@ -10,9 +10,11 @@ mesh; XLA overlaps the gradient all-reduce with the backward pass instead
 of issuing one blocking collective per parameter (tuto.md:319-320's noted
 didactic gap, closed).
 
-Uses real MNIST IDX files when present (``$TPU_DIST_DATA_DIR``), otherwise
-the deterministic synthetic stand-in (zero-egress container) — see
-`tpu_dist.data.mnist`.
+Uses real MNIST IDX files when present (``$TPU_DIST_DATA_DIR``, see
+tools/fetch_mnist.py), otherwise the deterministic synthetic stand-in
+(zero-egress container) — see `tpu_dist.data.mnist`.  ``--data digits``
+trains on REAL handwritten pixels in any environment (sklearn's bundled
+digit scans, `tpu_dist.data.digits`).
 """
 
 from _common import parse_args
@@ -25,21 +27,32 @@ def main():
         samples=(int, 0, "cap dataset size (0 = full 60k)"),
         trace=(str, "", "jax.profiler trace dir (perfetto) for epoch 0"),
         ckpt=(str, "", "checkpoint dir; resumes from the newest epoch"),
+        data=(str, "mnist", "mnist | digits (real bundled handwriting)"),
+        lr=(float, 0.01, "learning rate (reference: 0.01)"),
     )
     from tpu_dist import comm, data, models, train
 
     world = args.world or len(comm.devices(args.platform))
     mesh = comm.make_mesh(world, ("data",), platform=args.platform)
-    ds = data.load_mnist("train", synthetic_size=args.samples or None)
-    kind = "synthetic" if ds.synthetic else "real"
-    print(f"MNIST ({kind}, {len(ds)} samples) on {world} ranks "
-          f"[{mesh.devices.flat[0].platform}]")
+    if args.data == "digits":
+        ds = data.load_real_digits("train")
+        if args.samples:
+            ds = data.Dataset(
+                ds.images[: args.samples], ds.labels[: args.samples]
+            )
+        print(f"digits (real, {len(ds)} samples) on {world} ranks "
+              f"[{mesh.devices.flat[0].platform}]")
+    else:
+        ds = data.load_mnist("train", synthetic_size=args.samples or None)
+        kind = "synthetic" if ds.synthetic else "real"
+        print(f"MNIST ({kind}, {len(ds)} samples) on {world} ranks "
+              f"[{mesh.devices.flat[0].platform}]")
 
     trainer = train.Trainer(
         models.mnist_net(),
         models.IN_SHAPE,
         mesh,
-        train.TrainConfig(epochs=args.epochs),
+        train.TrainConfig(epochs=args.epochs, lr=args.lr),
     )
     start_epoch = 0
     if args.ckpt:
@@ -58,7 +71,13 @@ def main():
         checkpoint_dir=args.ckpt or None,
         trace_dir=args.trace or None,
     )
-    test = data.load_mnist("test", synthetic_size=min(10000, len(ds)) if ds.synthetic else None)
+    if args.data == "digits":
+        test = data.load_real_digits("test")
+    else:
+        test = data.load_mnist(
+            "test",
+            synthetic_size=min(10000, len(ds)) if ds.synthetic else None,
+        )
     print(f"Test accuracy: {trainer.evaluate(test):.4f}")
 
 

@@ -1,0 +1,90 @@
+"""Fetch the real MNIST IDX files — the reference's ``datasets.MNIST(
+download=True)`` analog (train_dist.py:76-83).
+
+This build container has ZERO egress, so the fetch cannot run here; it
+exists so a data-ful deploy gets reference-accuracy parity automatically:
+
+    python tools/fetch_mnist.py [--dir data/mnist]
+
+Tries the standard mirrors in order, verifies IDX magic numbers and
+counts, and writes the four canonical files where
+``tpu_dist.data.load_mnist`` searches (``$TPU_DIST_DATA_DIR`` or
+``data/mnist``).  Idempotent: verified existing files are not re-fetched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import struct
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+MIRRORS = (
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+    "http://yann.lecun.com/exdb/mnist/",
+)
+
+FILES = {
+    "train-images-idx3-ubyte": (2051, 60000),
+    "train-labels-idx1-ubyte": (2049, 60000),
+    "t10k-images-idx3-ubyte": (2051, 10000),
+    "t10k-labels-idx1-ubyte": (2049, 10000),
+}
+
+
+def verify(path: Path, magic: int, count: int) -> bool:
+    try:
+        with open(path, "rb") as f:
+            got_magic, got_n = struct.unpack(">II", f.read(8))
+        return got_magic == magic and got_n == count
+    except Exception:
+        return False
+
+
+def fetch_one(name: str, dest: Path, timeout: float) -> bool:
+    for mirror in MIRRORS:
+        url = f"{mirror}{name}.gz"
+        try:
+            print(f"  {url} ...", flush=True)
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                raw = gzip.decompress(r.read())
+            dest.write_bytes(raw)
+            return True
+        except (urllib.error.URLError, OSError, EOFError) as e:
+            print(f"    failed: {e}", file=sys.stderr)
+    return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="data/mnist", help="output directory")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    args = ap.parse_args()
+    out = Path(args.dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    ok = True
+    for name, (magic, count) in FILES.items():
+        dest = out / name
+        if verify(dest, magic, count):
+            print(f"{name}: already present and valid")
+            continue
+        print(f"{name}: fetching")
+        if fetch_one(name, dest, args.timeout) and verify(dest, magic, count):
+            print(f"{name}: OK ({dest.stat().st_size:,} bytes)")
+        else:
+            ok = False
+            print(
+                f"{name}: FAILED — zero-egress environment? Place the IDX "
+                f"files in {out}/ manually and load_mnist will use them.",
+                file=sys.stderr,
+            )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

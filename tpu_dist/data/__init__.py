@@ -1,6 +1,7 @@
 """`tpu_dist.data` — partitioning and loading (SURVEY.md §1 L4)."""
 
 from tpu_dist.data.cifar import load_cifar10, synthetic_cifar10, synthetic_images
+from tpu_dist.data.digits import load_real_digits
 from tpu_dist.data.loader import DistributedLoader, Loader, prefetch_to_mesh
 from tpu_dist.data.mnist import (
     Dataset,
@@ -22,6 +23,7 @@ __all__ = [
     "load_idx_images",
     "load_idx_labels",
     "load_mnist",
+    "load_real_digits",
     "prefetch_to_mesh",
     "synthetic_cifar10",
     "synthetic_images",

@@ -1,0 +1,44 @@
+"""Real handwritten digits with zero egress — sklearn's bundled set.
+
+The reference trains on real MNIST (train_dist.py:76-83); this build
+container cannot download it (see tools/fetch_mnist.py for data-ful
+deploys).  What the image DOES bundle is scikit-learn's UCI optical
+recognition digits: 1,797 genuine handwritten 8×8 samples shipped inside
+the sklearn wheel.  ``load_real_digits`` upsamples them to the MNIST
+geometry (28, 28, 1) so the reference-parity ConvNet trains unmodified —
+real pixels through the full pipeline, clearly labeled as not-MNIST.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_dist.data.mnist import MEAN, STD, Dataset
+
+TRAIN_FRACTION = 0.8
+_SPLIT_SEED = 1234  # the reference's seed (train_dist.py:35)
+
+
+def load_real_digits(split: str = "train") -> Dataset:
+    """Deterministic 80/20 split of sklearn's real digit scans.
+
+    8×8 → 28×28 by 3× nearest-neighbor upsampling (24×24) + 2px border,
+    then the reference's MNIST normalization constants.  The split
+    shuffle is seeded so every process computes identical disjoint
+    train/test sets with no communication (the SURVEY §2c.6 invariant).
+    """
+    from sklearn.datasets import load_digits as _sk_load
+
+    bunch = _sk_load()
+    images = bunch.images.astype(np.float32) / 16.0  # (1797, 8, 8) in [0,1]
+    labels = bunch.target.astype(np.int32)
+
+    up = images.repeat(3, axis=1).repeat(3, axis=2)  # (n, 24, 24)
+    up = np.pad(up, ((0, 0), (2, 2), (2, 2)))  # (n, 28, 28)
+    imgs = ((up - MEAN) / STD)[..., None].astype(np.float32)
+
+    rng = np.random.default_rng(_SPLIT_SEED)
+    order = rng.permutation(len(imgs))
+    n_train = int(len(imgs) * TRAIN_FRACTION)
+    idx = order[:n_train] if split == "train" else order[n_train:]
+    return Dataset(imgs[idx], labels[idx], synthetic=False)
