@@ -162,7 +162,10 @@ class TestFlashAttention:
 class TestPallasRing:
     def test_falls_back_off_tpu(self):
         """On CPU the RDMA kernel is not executable; the entry point must
-        give the ppermute ring result."""
+        give the ppermute ring result — and WARN that it did (so no
+        benchmark can pass off fallback numbers as kernel numbers)."""
+        import pytest
+
         from tests.conftest import spmd_run as run
         from tpu_dist import comm
 
@@ -170,7 +173,8 @@ class TestPallasRing:
             x = jnp.arange(8.0) + comm.rank()
             return ops.ring_all_reduce_pallas(x)
 
-        out = np.asarray(run(fn, world=4))
+        with pytest.warns(RuntimeWarning, match="NOT RDMA"):
+            out = np.asarray(run(fn, world=4))
         expect = np.stack([np.arange(8.0) + r for r in range(4)]).sum(0)
         for r in range(4):
             np.testing.assert_allclose(out[r], expect)

@@ -44,6 +44,52 @@ def render(src: Path, dst: Path) -> str:
     return mode
 
 
+def _wrap(line: str, width: int = 94) -> list[str]:
+    if len(line) <= width:
+        return [line]
+    import textwrap
+
+    pad = " " * (len(line) - len(line.lstrip()))
+    return textwrap.wrap(
+        line.strip(), width,
+        initial_indent=pad, subsequent_indent=pad, break_long_words=False,
+    ) or [line]
+
+
+def render_pdf(src: Path, dst: Path, lines_per_page: int = 72) -> bool:
+    """Render a markdown doc to a paginated PDF — the ``tuto.pdf`` analog
+    (reference Makefile:4-6 ships a PDF build of the tutorial).  Uses
+    matplotlib's PDF backend (the only PDF writer in this image); layout
+    is monospaced text, which suits a code-heavy tutorial."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        from matplotlib.backends.backend_pdf import PdfPages
+    except ImportError:
+        return False
+
+    lines: list[str] = []
+    for raw in src.read_text().splitlines():
+        lines.extend(_wrap(raw))
+    pages = [
+        lines[i : i + lines_per_page]
+        for i in range(0, len(lines), lines_per_page)
+    ]
+    with PdfPages(dst) as pdf:
+        for num, page in enumerate(pages, 1):
+            fig = plt.figure(figsize=(8.27, 11.69))  # A4 portrait
+            fig.text(
+                0.06, 0.97, "\n".join(page),
+                va="top", ha="left", family="monospace", fontsize=7.2,
+            )
+            fig.text(0.5, 0.02, str(num), ha="center", fontsize=8)
+            pdf.savefig(fig)
+            plt.close(fig)
+    return True
+
+
 def main():
     docs = Path(__file__).parent.parent / "docs"
     out = docs / "html"
@@ -57,6 +103,13 @@ def main():
     if tut.exists():
         (out / "index.html").write_text(tut.read_text())
         print("tutorial.html -> docs/html/index.html")
+    # the reference also ships tuto.pdf (Makefile:4-6)
+    tut_md = docs / "tutorial.md"
+    if tut_md.exists():
+        if render_pdf(tut_md, docs / "tutorial.pdf"):
+            print("tutorial.md -> docs/tutorial.pdf")
+        else:
+            print("tutorial.pdf skipped (no PDF backend in this image)")
 
 
 if __name__ == "__main__":

@@ -96,13 +96,24 @@ def ring_all_reduce_pallas(
 ) -> jax.Array:
     """Ring all-reduce via explicit RDMA when running on ≥2 TPU chips;
     falls back to the ppermute ring elsewhere (CPU simulation has no
-    inter-chip DMA to program).  Call inside shard_map over ``axis_name``
+    inter-chip DMA to program).  The fallback WARNS loudly so a benchmark
+    or test can never silently report "RDMA kernel" numbers that ran the
+    ppermute path instead.  Call inside shard_map over ``axis_name``
     (which must be the mesh's only axis for LOGICAL device ids to equal
     ring positions)."""
+    import warnings
+
     try:
         platform = jax.devices()[0].platform
     except RuntimeError:  # pragma: no cover
         platform = "cpu"
     if platform != "tpu":
+        warnings.warn(
+            f"ring_all_reduce_pallas: not on TPU (platform={platform!r}) — "
+            f"falling back to the ppermute ring; any numbers produced are "
+            f"NOT RDMA-kernel numbers",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return ring_all_reduce_chunked(x, axis_name)
     return _pallas_ring(x, axis_name, collective_id)
