@@ -34,6 +34,11 @@ from tpu_dist.parallel.pipeline import (
     stack_chunk_params,
     stack_stage_params,
 )
+from tpu_dist.parallel.fsdp import (
+    fsdp_gather_params,
+    fsdp_shard_params,
+    make_fsdp_train_step,
+)
 from tpu_dist.parallel.ulysses import ulysses_attention
 from tpu_dist.parallel.tensor_parallel import (
     MODEL_AXIS,
@@ -54,6 +59,8 @@ __all__ = [
     "EXPERT_AXIS",
     "MODEL_AXIS",
     "PIPE_AXIS",
+    "fsdp_gather_params",
+    "fsdp_shard_params",
     "gpipe_bubble_fraction",
     "gpipe_ticks",
     "interleaved_bubble_fraction",
@@ -70,6 +77,7 @@ __all__ = [
     "row_parallel",
     "shard_dim",
     "tp_mlp",
+    "make_fsdp_train_step",
     "make_stateful_train_step",
     "make_train_step",
     "make_train_step_auto",

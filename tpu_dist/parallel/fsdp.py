@@ -1,0 +1,157 @@
+"""Fully-sharded data parallelism (ZeRO-3 style) over the ``data`` axis.
+
+Beyond the reference (its DP replicates the model on every rank,
+train_dist.py:107 + tuto.md:216); this is the memory-scaled variant:
+parameters, gradients, and optimizer state are all sharded 1/n per rank,
+with parameters gathered just-in-time for compute.
+
+TPU-first design: everything happens inside ONE compiled shard_map
+program per step —
+
+- each leaf is stored flattened and padded to ``(n, k)``, sharded
+  ``P(axis)`` (rank r holds row r: 1/n of the leaf);
+- forward/backward: ``all_gather`` (tiled) un-shards each leaf to its
+  original shape, XLA overlapping the gathers with compute;
+- gradients: flat-pad then ``psum_scatter`` (XLA ReduceScatter) /n — each
+  rank reduces exactly its shard, wire cost identical to the allreduce
+  the replicated path pays (RS + AG == allreduce, tuto.md:354's identity);
+- update: the optimizer's elementwise pytree update runs on the local
+  (1, k) shards, so its state (momentum/adam moments) is born sharded.
+
+Padding is benign: padded grads are zero, so padded param/opt entries
+stay exactly zero under SGD/momentum/AdamW.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_dist.parallel.data_parallel import DATA_AXIS, _pmean_float_leaves
+from tpu_dist.utils.tree import pad_to_multiple
+
+
+def _pad_rows(flat: jax.Array, n: int) -> jax.Array:
+    return pad_to_multiple(flat, n).reshape(n, -1)
+
+
+def fsdp_shard_params(params: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
+    """Shard a full parameter pytree: every leaf becomes an ``(n, k)``
+    array sharded ``P(axis_name)`` (row r on rank r, zero-padded)."""
+    n = mesh.shape[axis_name]
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(
+        lambda p: jax.device_put(_pad_rows(jnp.ravel(p), n), sharding), params
+    )
+
+
+def fsdp_gather_params(sharded: Any, template: Any) -> Any:
+    """Reassemble full parameters from FSDP shards (host-side: eval,
+    checkpointing).  ``template`` supplies the original shapes/dtypes.
+
+    Single-host only: shards living on another process's devices cannot
+    be fetched here — on a multi-host pod, checkpoint the sharded arrays
+    directly (orbax handles distributed arrays) or gather inside a
+    compiled program."""
+    import numpy as np
+
+    for leaf in jax.tree.leaves(sharded):
+        if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            raise RuntimeError(
+                "fsdp_gather_params: shards span non-addressable devices "
+                "(multi-host mesh) — checkpoint the sharded pytree with "
+                "orbax, or all_gather inside a jitted fn instead"
+            )
+    return jax.tree.map(
+        lambda s, t: jnp.asarray(np.asarray(s).reshape(-1)[: math.prod(t.shape)])
+        .reshape(t.shape)
+        .astype(t.dtype),
+        sharded,
+        template,
+    )
+
+
+def make_fsdp_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer,
+    mesh: Mesh,
+    params: Any,
+    *,
+    axis_name: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """Build the compiled FSDP train step.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch, key) -> (loss, aux)`` on the local
+        batch shard (same contract as `make_train_step`).
+      optimizer: `tpu_dist.train.optim.Optimizer`; its state is created
+        over the SHARDED leaves, so it is 1/n per rank by construction.
+      mesh: mesh whose ``axis_name`` axis shards batch AND model state.
+      params: the full initial parameter pytree (consumed: returned
+        sharded).
+
+    Returns ``(step, sharded_params, opt_state)`` with
+    ``step(sharded_params, opt_state, batch, key) -> (sharded_params,
+    opt_state, loss, aux)`` — batch sharded on its leading axis, loss
+    replicated (pmean), params/opt-state permanently sharded.
+    """
+    n = mesh.shape[axis_name]
+    template = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+    )
+    sharded_params = fsdp_shard_params(params, mesh, axis_name)
+    opt_state = optimizer.init(sharded_params)
+
+    def unshard(local_shards):
+        def un(s, t):
+            full = lax.all_gather(s, axis_name, axis=0, tiled=True)
+            return full.reshape(-1)[: math.prod(t.shape)].reshape(t.shape)
+
+        return jax.tree.map(un, local_shards, template)
+
+    def shard_grads(grads):
+        # flat-pad to (n, k) then ReduceScatter: rank r reduces row r.
+        return jax.tree.map(
+            lambda g: lax.psum_scatter(
+                _pad_rows(jnp.ravel(g), n), axis_name,
+                scatter_dimension=0, tiled=True,
+            )
+            / n,
+            grads,
+        )
+
+    def spmd_step(local_shards, opt_state, batch, key):
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        full = unshard(local_shards)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            full, batch, key
+        )
+        gshards = shard_grads(grads)
+        new_shards, new_opt = optimizer.update(local_shards, gshards, opt_state)
+        # aux mirrors make_stateful_train_step's contract: float leaves
+        # are cross-rank means, not one rank's local value.
+        aux = _pmean_float_leaves(aux, axis_name)
+        return new_shards, new_opt, lax.pmean(loss, axis_name), aux
+
+    # Per-leaf specs: (n, k) leaves are sharded on the axis; scalar leaves
+    # (e.g. a schedule step counter) are replicated.
+    def spec_of(leaf):
+        return P(axis_name) if jnp.ndim(leaf) >= 1 else P()
+
+    p_specs = jax.tree.map(spec_of, sharded_params)
+    o_specs = jax.tree.map(spec_of, opt_state)
+    mapped = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(p_specs, o_specs, P(axis_name), P()),
+        out_specs=(p_specs, o_specs, P(), P()),
+        check_vma=False,
+    )
+    step = jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+    return step, sharded_params, opt_state
