@@ -18,7 +18,6 @@ line the driver records.
 from __future__ import annotations
 
 import json
-import subprocess
 import sys
 import time
 
@@ -34,25 +33,20 @@ def ensure_live_backend(timeout_s: float = 120.0) -> None:
     container the TPU is reached through a tunnel that can hang
     indefinitely at init, which would wedge the whole benchmark.  If the
     probe can't produce devices in time, pin this process to CPU so the
-    bench always emits its JSON line (flagging the fallback on stderr)."""
-    code = "import jax; print(jax.devices()[0].platform)"
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-        if proc.returncode == 0:
-            log(f"backend probe: {proc.stdout.strip()}")
-            return
-        log(f"backend probe failed: {proc.stderr[-500:]}")
-    except subprocess.TimeoutExpired:
-        log(f"backend probe hung >{timeout_s:.0f}s (tunnel down?)")
-    from tpu_dist.utils.platform import pin_cpu
+    bench always emits its JSON line (flagging the fallback on stderr).
 
+    The probe must EXECUTE a computation and read the result back, not
+    just enumerate devices — the tunnel has a half-alive failure mode
+    where ``jax.devices()`` answers but any compile/execute hangs."""
+    from tpu_dist.utils.platform import probe_default_backend, pin_cpu
+
+    platform, detail = probe_default_backend(timeout_s)
+    if platform is not None:
+        log(f"backend probe: {platform}")
+        return
     pin_cpu()
-    log("falling back to CPU — numbers are NOT TPU numbers")
+    log(f"backend probe failed ({detail}) — "
+        "falling back to CPU — numbers are NOT TPU numbers")
 
 
 BATCH = 128

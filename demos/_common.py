@@ -6,7 +6,11 @@ flags pick its size and platform ('cpu' simulates a cluster on one host
 exactly like the reference's loopback forks — SURVEY.md §4.2).
 
 Run with no flags on a TPU host to use all chips; run with
-``--platform cpu --world 8`` anywhere.
+``--platform cpu --world 8`` anywhere.  Bare runs pay a one-off
+compute-liveness probe of the default backend (subprocess, bounded) so a
+dead/half-alive TPU tunnel degrades to CPU-sim instead of hanging; pass
+``--platform tpu`` (or set TPU_DIST_PLATFORM) to skip the probe on a host
+you trust.
 """
 
 from __future__ import annotations
@@ -37,4 +41,13 @@ def parse_args(default_world: int | None = None, **extra):
         from tpu_dist.utils.platform import pin_cpu
 
         pin_cpu(args.world or 8)
+    elif args.platform is None:
+        # "Best available": verify the default backend can actually run a
+        # computation before this process touches it — a tunneled TPU can
+        # hang at first compile while still enumerating devices.  Falls
+        # back to CPU-sim (with a RuntimeWarning) so bare demo runs always
+        # produce their known-answer output.
+        from tpu_dist.utils.platform import pin_cpu_if_backend_dead
+
+        args.platform = pin_cpu_if_backend_dead(args.world or 8)
     return args

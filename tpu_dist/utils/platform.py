@@ -15,6 +15,73 @@ import os
 import warnings
 
 
+def probe_default_backend(
+    timeout_s: float = 90.0,
+) -> tuple[str | None, str]:
+    """Check — in a SUBPROCESS — that the default JAX backend can actually
+    EXECUTE a computation.  Returns ``(platform, detail)``: the platform
+    name on success (detail empty), or ``None`` plus a human-readable
+    reason (timeout vs. error, with the probe's stderr tail).
+
+    Enumeration is not enough: a tunneled TPU backend has a half-alive
+    failure mode where ``jax.devices()`` answers but any compile/execute
+    hangs indefinitely.  The probe jits a tiny matmul and reads the result
+    back, so a None return means "do not let this process touch the
+    default backend" (pin to CPU instead).  Subprocess isolation keeps a
+    hang from wedging the caller and leaves the chip unclaimed on failure.
+    """
+    import subprocess
+    import sys
+
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "x = jnp.ones((8, 8));"
+        "assert float(np.asarray(x @ x)[0, 0]) == 8.0;"
+        "print(jax.devices()[0].platform)"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"probe hung > {timeout_s:.0f}s (tunnel down?)"
+    if proc.returncode != 0:
+        return None, (
+            f"probe exited rc={proc.returncode}: {proc.stderr[-500:].strip()}"
+        )
+    out = proc.stdout.strip().splitlines()
+    if not out:
+        return None, "probe produced no output"
+    return out[-1], ""
+
+
+def pin_cpu_if_backend_dead(
+    n_devices: int | None = None, *, timeout_s: float = 90.0
+) -> str:
+    """Probe the default backend (see `probe_default_backend`); pin this
+    process to CPU — loudly — when it cannot execute.  When the default
+    backend IS the CPU, still applies the ``n_devices`` simulation (so
+    ``--world N`` behaves identically on CPU-only and dead-tunnel hosts).
+    Returns the platform the process will use ('cpu' on fallback)."""
+    platform, detail = probe_default_backend(timeout_s)
+    if platform == "cpu":
+        pin_cpu(n_devices)
+        return "cpu"
+    if platform is not None:
+        return platform
+    warnings.warn(
+        f"default JAX backend failed the compute-liveness probe ({detail}) "
+        "— falling back to CPU; numbers/outputs are NOT accelerator results",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+    pin_cpu(n_devices)
+    return "cpu"
+
+
 def pin_cpu(n_devices: int | None = None, *, opt_out_env: str | None = None) -> bool:
     """Restrict this process to the CPU platform, simulating ``n_devices``
     host devices, and VERIFY the pin took effect.
