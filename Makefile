@@ -7,7 +7,7 @@ PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
 .PHONY: test ptp gather allreduce train bench runtime train-image \
-        scaling multiproc longcontext train-lm docs demos
+        scaling multiproc longcontext train-lm generate docs demos
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -44,6 +44,9 @@ runtime:
 
 train-lm:
 	cd demos && $(PY) train_lm.py $(DEMOFLAGS)
+
+generate:
+	cd demos && $(PY) generate.py --platform $(PLATFORM)
 
 docs:
 	$(PY) tools/render_docs.py

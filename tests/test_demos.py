@@ -53,3 +53,12 @@ def test_train_dist_loss_decreases():
     last = float(lines[-1].rsplit(":", 1)[1].split("[")[0])
     assert last < first, out
     assert "Test accuracy:" in out
+
+
+def test_generate_follows_markov_chain():
+    out = run_demo(
+        "generate.py", "--platform", "cpu", "--steps", "120",
+        "--gen", "16", timeout=400,
+    )
+    acc = float(out.splitlines()[-1].split(":")[1].split("(")[0])
+    assert acc >= 0.9, out

@@ -11,6 +11,7 @@ from tpu_dist.models.transformer_lm import (
     TransformerLM,
     lm_loss,
     lm_loss_seq_parallel,
+    markov_table,
     synthetic_tokens,
 )
 from tpu_dist.models.vit import ViT, vit_tiny
@@ -23,6 +24,7 @@ __all__ = [
     "ViT",
     "lm_loss",
     "lm_loss_seq_parallel",
+    "markov_table",
     "mnist_net",
     "resnet18",
     "synthetic_tokens",

@@ -95,3 +95,45 @@ class MultiHeadAttention(Module):
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
         y, _ = self._out.apply(params["out"], {}, o)
         return y, state
+
+    def apply_cached(self, params, x, k_cache, v_cache, index):
+        """Incremental (KV-cache) forward for autoregressive decode.
+
+        ``x`` holds ``s`` NEW tokens whose global positions start at
+        ``index`` (a traced scalar is fine); their keys/values are written
+        into the static-shape caches ``(b, heads, cache_len, head_dim)``
+        with ``dynamic_update_slice`` and the queries attend over the
+        whole cache under a position mask (``pos <= index + q_offset``) —
+        static shapes throughout, so one compiled program serves every
+        decode step.  Returns ``(y, k_cache, v_cache)``.
+
+        Only meaningful for causal self-attention (decode IS causal);
+        raises otherwise to catch ViT-style misuse.
+        """
+        if not self.causal:
+            raise ValueError("apply_cached requires causal=True attention")
+        from jax import lax
+
+        b, s, _ = x.shape
+        qkv, _ = self._qkv.apply(params["qkv"], {}, x)
+        qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        k_cache = lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), index, axis=2
+        )
+        v_cache = lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), index, axis=2
+        )
+        cache_len = k_cache.shape[2]
+        scale = self.head_dim**-0.5
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q * scale, k_cache.astype(q.dtype)
+        )
+        pos = jnp.arange(cache_len)[None, :]
+        qpos = index + jnp.arange(s)[:, None]
+        logits = jnp.where(pos <= qpos, logits, -1e30)
+        weights = jax.nn.softmax(logits, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", weights, v_cache.astype(q.dtype))
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
+        y, _ = self._out.apply(params["out"], {}, o)
+        return y, k_cache, v_cache
