@@ -170,6 +170,21 @@ class TransformerLM(Module):
         _, toks = lax.scan(body, (cache, last, jnp.int32(s_p)), keys)
         return jnp.moveaxis(toks, 0, 1)
 
+    def apply_tensor_parallel(self, params, tokens, axis_name):
+        """Tensor-parallel forward for use INSIDE shard_map over a
+        ``model`` axis: attention heads and MLP hidden dims shard across
+        ranks (Megatron layout, two psums per block —
+        `tpu_dist.parallel.tp_encoder_block`); embeddings, LayerNorms and
+        the tied vocab head stay replicated.  Same replicated params as
+        `apply`; tests assert fp-tolerance agreement."""
+        from tpu_dist.parallel.tensor_parallel import tp_encoder_block
+
+        h = self._trunk(params, tokens)
+        for blk, pb in zip(self.blocks, params["blocks"]):
+            h = tp_encoder_block(blk, pb, h, axis_name)
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        return h @ params["embed"]["table"].T
+
     def apply_seq_parallel(self, params, tokens_local, axis_name):
         """Sequence-parallel forward for use INSIDE shard_map: tokens are
         the local sequence shard; attention runs as a ppermute ring over

@@ -45,7 +45,10 @@ from tpu_dist.parallel.tensor_parallel import (
     column_parallel,
     row_parallel,
     shard_dim,
+    tp_attention,
+    tp_encoder_block,
     tp_mlp,
+    tp_mlp_block,
 )
 from tpu_dist.parallel.ring import (
     ring_all_gather,
@@ -76,7 +79,10 @@ __all__ = [
     "column_parallel",
     "row_parallel",
     "shard_dim",
+    "tp_attention",
+    "tp_encoder_block",
     "tp_mlp",
+    "tp_mlp_block",
     "make_fsdp_train_step",
     "make_stateful_train_step",
     "make_train_step",

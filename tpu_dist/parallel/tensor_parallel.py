@@ -74,3 +74,86 @@ def tp_mlp(
     down = shard_dim(w_down, axis_name, 0)
     hidden = activation(column_parallel(x, up, axis_name))
     return row_parallel(hidden, down, axis_name)
+
+
+def tp_mlp_block(
+    x: jax.Array,
+    mlp_params,
+    axis_name: str = MODEL_AXIS,
+    *,
+    activation=jax.nn.gelu,
+) -> jax.Array:
+    """`tp_mlp` over the model zoo's MLP param pytree
+    (``{"fc1": {"w","b"}, "fc2": {"w","b"}}`` — models/vit.py MLP),
+    biases included: fc1's bias is column-sharded with its weights, fc2's
+    is added once after the psum.  Still exactly ONE collective."""
+    w1 = shard_dim(mlp_params["fc1"]["w"], axis_name, 1)
+    b1 = shard_dim(mlp_params["fc1"]["b"], axis_name, 0)
+    w2 = shard_dim(mlp_params["fc2"]["w"], axis_name, 0)
+    hidden = activation(x @ w1 + b1)
+    return lax.psum(hidden @ w2, axis_name) + mlp_params["fc2"]["b"]
+
+
+def tp_attention(
+    x: jax.Array,
+    attn_params,
+    heads: int,
+    axis_name: str = MODEL_AXIS,
+    *,
+    causal: bool = False,
+) -> jax.Array:
+    """Megatron-style sharded-heads attention: each rank runs
+    ``heads / axis_size`` complete heads locally and the row-parallel
+    output projection finishes with ONE psum.
+
+    ``attn_params`` is `nn.MultiHeadAttention`'s replicated pytree
+    (``{"qkv": {"w","b"}, "out": {"w","b"}}``).  The QKV projection is
+    column-parallel per head: the flat ``(dim, 3*dim)`` kernel's output
+    layout is ``(3, heads, head_dim)`` (attention.py reshape), so the
+    per-rank shard slices the HEAD axis of the reshaped kernel — a head
+    never straddles ranks, which is what keeps softmax communication-free.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    if heads % n:
+        raise ValueError(f"heads {heads} not divisible by axis size {n}")
+    hl = heads // n
+    w = attn_params["qkv"]["w"]
+    d = w.shape[0]
+    hd = w.shape[1] // (3 * heads)
+    w_loc = lax.dynamic_slice_in_dim(
+        w.reshape(d, 3, heads, hd), r * hl, hl, 2
+    ).reshape(d, 3 * hl * hd)
+    b_loc = lax.dynamic_slice_in_dim(
+        attn_params["qkv"]["b"].reshape(3, heads, hd), r * hl, hl, 1
+    ).reshape(3 * hl * hd)
+
+    from tpu_dist.nn.attention import dot_product_attention
+
+    bsz, s, _ = x.shape
+    qkv = (x @ w_loc + b_loc).reshape(bsz, s, 3, hl, hd)
+    q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    o = dot_product_attention(q, k, v, causal=causal)  # (b, hl, s, hd)
+    o = jnp.moveaxis(o, 1, 2).reshape(bsz, s, hl * hd)
+
+    wo_loc = lax.dynamic_slice_in_dim(
+        attn_params["out"]["w"], r * hl * hd, hl * hd, 0
+    )
+    return lax.psum(o @ wo_loc, axis_name) + attn_params["out"]["b"]
+
+
+def tp_encoder_block(block, params, x, axis_name: str = MODEL_AXIS):
+    """A full pre-norm transformer block (models/vit.py EncoderBlock) in
+    tensor parallel: LayerNorms replicated (tiny), attention heads and
+    MLP hidden dim sharded — TWO psums per block total, the Megatron
+    layout.  ``block`` is the EncoderBlock instance (supplies the
+    LayerNorm modules and the heads/causal config); ``params`` its
+    replicated pytree.  Numerics match ``block.apply`` to fp tolerance
+    (tests/test_tensor_parallel.py)."""
+    h, _ = block.ln1.apply(params["ln1"], {}, x)
+    x = x + tp_attention(
+        h, params["attn"], block.attn.heads, axis_name,
+        causal=block.attn.causal,
+    )
+    h, _ = block.ln2.apply(params["ln2"], {}, x)
+    return x + tp_mlp_block(h, params["mlp"], axis_name)
