@@ -208,3 +208,94 @@ def test_torch_momentum_semantics():
     np.testing.assert_allclose(np.asarray(p["w"]), [0.5])
     p, s = opt.update(p, g, s)  # buf=1.5, p=0.5-0.75=-0.25
     np.testing.assert_allclose(np.asarray(p["w"]), [-0.25])
+
+
+class TestGradAccumulation:
+    """accum_steps=k must reproduce the unaccumulated step: same mean
+    gradient, same update — with only one microbatch's activations live."""
+
+    def _setup(self):
+        import jax.numpy as jnp
+
+        from tpu_dist import comm, models, parallel, train
+
+        mesh = comm.make_mesh(2, ("data",), platform="cpu")
+        model = models.mnist_net()
+        params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+        opt = train.sgd(0.05, momentum=0.9)
+
+        def loss_fn(p, s, batch, key):
+            x, y = batch
+            scores, s2 = model.apply(p, s, x, train=False)
+            from tpu_dist import nn
+
+            return nn.nll_loss(scores, y), (s2, {"l": nn.nll_loss(scores, y)})
+
+        x = jax.random.normal(jax.random.key(1), (16,) + models.IN_SHAPE)
+        y = jax.random.randint(jax.random.key(2), (16,), 0, 10)
+        batch = parallel.shard_batch((x, y), mesh)
+        return mesh, model, params, state, opt, loss_fn, batch
+
+    def test_accum_matches_single_step(self):
+        import numpy as np
+
+        from tpu_dist import parallel
+
+        mesh, model, params, state, opt, loss_fn, batch = self._setup()
+        outs = {}
+        for k in (1, 4):
+            step = parallel.make_stateful_train_step(
+                loss_fn, opt, mesh, accum_steps=k, donate=False
+            )
+            p = parallel.replicate(params, mesh)
+            s = parallel.replicate(state, mesh)
+            o = parallel.replicate(opt.init(params), mesh)
+            p, s, o, loss, aux = step(p, s, o, batch, jax.random.key(3))
+            outs[k] = (jax.tree.map(np.asarray, p), float(loss), float(aux["l"]))
+        flat1 = jax.tree.leaves(outs[1][0])
+        flat4 = jax.tree.leaves(outs[4][0])
+        for a, b in zip(flat1, flat4):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=1e-6)
+        assert abs(outs[1][1] - outs[4][1]) < 1e-5
+        assert abs(outs[1][2] - outs[4][2]) < 1e-5
+
+    def test_indivisible_microbatch_raises(self):
+        import pytest
+
+        from tpu_dist import parallel
+
+        mesh, model, params, state, opt, loss_fn, batch = self._setup()
+        step = parallel.make_stateful_train_step(
+            loss_fn, opt, mesh, accum_steps=3, donate=False
+        )
+        p = parallel.replicate(params, mesh)
+        s = parallel.replicate(state, mesh)
+        o = parallel.replicate(opt.init(params), mesh)
+        with pytest.raises(ValueError, match="not divisible"):
+            step(p, s, o, batch, jax.random.key(0))  # 8 local % 3 != 0
+
+    def test_accum_zero_raises(self):
+        import pytest
+
+        from tpu_dist import parallel
+
+        mesh, model, params, state, opt, loss_fn, batch = self._setup()
+        with pytest.raises(ValueError, match="accum_steps"):
+            parallel.make_stateful_train_step(
+                loss_fn, opt, mesh, accum_steps=0
+            )
+
+    def test_trainer_accum_config(self):
+        """Trainer wiring: accum_steps config trains and losses are finite."""
+        import numpy as np
+
+        from tpu_dist import comm, data, models, train
+
+        mesh = comm.make_mesh(2, ("data",), platform="cpu")
+        cfg = train.TrainConfig(
+            epochs=1, global_batch=32, accum_steps=2, log=lambda s: None
+        )
+        trainer = train.Trainer(models.mnist_net(), models.IN_SHAPE, mesh, cfg)
+        ds = data.load_mnist("train", synthetic_size=128)
+        hist = trainer.fit(ds, epochs=1)
+        assert np.isfinite(hist[0].mean_loss)

@@ -138,6 +138,7 @@ def make_stateful_train_step(
     axis_name: str = DATA_AXIS,
     donate: bool = True,
     grad_reduce: str = "psum",
+    accum_steps: int = 1,
 ):
     """Like `make_train_step` but threads non-differentiated model state
     (e.g. batch-norm running statistics) through the step.
@@ -148,13 +149,68 @@ def make_stateful_train_step(
     leaves are cross-replica averaged (SyncBN-style statistics), keeping
     replicas bit-identical — the reference's cross-rank identity invariant
     (SURVEY.md §2c.6) extended to stateful models.
-    """
 
-    def spmd_step(params, model_state, opt_state, batch, key):
-        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    ``accum_steps=k`` enables gradient accumulation: each rank's batch
+    shard is split into ``k`` microbatches processed by a ``lax.scan``
+    whose carry accumulates the gradient sum — so only ONE microbatch's
+    activations are ever live (HBM scales with ``local_batch / k``), the
+    optimizer still sees the mean gradient over the full global batch,
+    and the collective still fires once per step.  Stateless models match
+    the unaccumulated step to fp tolerance (tests); model state threads
+    through microbatches sequentially (its per-microbatch semantics —
+    e.g. BN statistics see smaller batches — are inherent to
+    accumulation).  Aux float leaves are averaged over microbatches.
+    """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+
+    def grads_and_metrics(params, model_state, batch, key):
+        """(grads, loss, new_state, aux) for one (micro)batch."""
         (loss, (new_state, aux)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params, model_state, batch, key)
+        return grads, loss, new_state, aux
+
+    def accumulate(params, model_state, batch, key):
+        """Scan over microbatches, summing grads/loss in the carry."""
+        def to_micro(a):
+            if a.shape[0] % accum_steps:
+                raise ValueError(
+                    f"local batch {a.shape[0]} not divisible by "
+                    f"accum_steps {accum_steps}"
+                )
+            return a.reshape(
+                (accum_steps, a.shape[0] // accum_steps) + a.shape[1:]
+            )
+
+        micro = jax.tree.map(to_micro, batch)
+        g0 = jax.tree.map(jnp.zeros_like, params)
+
+        def body(carry, xs):
+            state, gacc, lacc = carry
+            mb, i = xs
+            g, loss, state, aux = grads_and_metrics(
+                params, state, mb, jax.random.fold_in(key, i)
+            )
+            gacc = jax.tree.map(jnp.add, gacc, g)
+            return (state, gacc, lacc + loss), aux
+
+        (new_state, gsum, lsum), auxs = lax.scan(
+            body, (model_state, g0, 0.0), (micro, jnp.arange(accum_steps))
+        )
+        grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+        aux = jax.tree.map(
+            lambda a: a.mean(0)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else a[-1],
+            auxs,
+        )
+        return grads, lsum / accum_steps, new_state, aux
+
+    def spmd_step(params, model_state, opt_state, batch, key):
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        local = grads_and_metrics if accum_steps == 1 else accumulate
+        grads, loss, new_state, aux = local(params, model_state, batch, key)
         grads = average_gradients(grads, axis_name, backend=grad_reduce)
         loss = lax.pmean(loss, axis_name)
         new_state = _pmean_float_leaves(new_state, axis_name)

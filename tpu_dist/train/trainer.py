@@ -49,6 +49,10 @@ class TrainConfig:
     # rematerializes the forward in the backward pass (HBM for FLOPs).
     compute_dtype: str | None = None
     remat: bool = False
+    # Gradient accumulation: split each rank's shard into this many
+    # microbatches scanned sequentially (activations HBM / accum_steps);
+    # optimizer math unchanged (mean gradient over the global batch).
+    accum_steps: int = 1
 
 
 @dataclass
@@ -118,7 +122,8 @@ class Trainer:
             return self._loss(scores, y), (new_state, {})
 
         self.step = parallel.make_stateful_train_step(
-            loss_fn, self.optimizer, mesh
+            loss_fn, self.optimizer, mesh,
+            accum_steps=self.config.accum_steps,
         )
         self._eval_apply = jax.jit(
             lambda params, state, x: model.apply(params, state, x, train=False)[0]
