@@ -162,3 +162,55 @@ def test_checkpoint_structure_mismatch_raises(tmp_path, mesh):
     train.checkpoint.save(ckpt, {"params": t.params}, step=1)
     with pytest.raises(ValueError, match="structure mismatch"):
         train.checkpoint.restore(ckpt, {"different": t.params})
+
+
+def test_global_norm_and_clipping():
+    """clip_by_global_norm scales only when the norm exceeds the bound,
+    and the wrapped update equals the base update on the scaled grads."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist import train
+
+    params = {"a": jnp.ones((3,)), "b": jnp.ones((2, 2))}
+    grads = {"a": jnp.full((3,), 3.0), "b": jnp.full((2, 2), 4.0)}
+    norm = float(train.global_norm(grads))
+    np.testing.assert_allclose(norm, np.sqrt(3 * 9 + 4 * 16), rtol=1e-6)
+
+    base = train.sgd(0.1)
+    clipped = train.clip_by_global_norm(base, max_norm=1.0)
+    p1, _ = clipped.update(params, grads, clipped.init(params))
+    scaled = jax.tree.map(lambda g: g / norm, grads)
+    p2, _ = base.update(params, scaled, base.init(params))
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+    # under the bound: identity
+    tiny = jax.tree.map(lambda g: g * 1e-3 / norm, grads)
+    p3, _ = clipped.update(params, tiny, clipped.init(params))
+    p4, _ = base.update(params, tiny, base.init(params))
+    for a, b in zip(jax.tree.leaves(p3), jax.tree.leaves(p4)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    import pytest
+
+    with pytest.raises(ValueError, match="max_norm"):
+        train.clip_by_global_norm(base, 0.0)
+
+
+def test_clipped_optimizer_in_trainer():
+    """Clipping wraps transparently into the DP train step."""
+    import numpy as np
+
+    from tpu_dist import comm, data, models, train
+
+    mesh = comm.make_mesh(2, ("data",), platform="cpu")
+    opt = train.clip_by_global_norm(train.sgd(0.01, 0.5), max_norm=0.5)
+    trainer = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh,
+        train.TrainConfig(log=lambda s: None, global_batch=32),
+        optimizer=opt,
+    )
+    ds = data.load_mnist("train", synthetic_size=128)
+    hist = trainer.fit(ds, epochs=1)
+    assert np.isfinite(hist[0].mean_loss)

@@ -1,7 +1,13 @@
 """`tpu_dist.train` — optimizers, trainer, checkpointing, metrics."""
 
 from tpu_dist.train import checkpoint, flops, metrics, schedule
-from tpu_dist.train.optim import Optimizer, adamw, sgd
+from tpu_dist.train.optim import (
+    Optimizer,
+    adamw,
+    clip_by_global_norm,
+    global_norm,
+    sgd,
+)
 from tpu_dist.train.trainer import EpochStats, TrainConfig, Trainer
 
 __all__ = [
@@ -10,6 +16,8 @@ __all__ = [
     "TrainConfig",
     "Trainer",
     "adamw",
+    "clip_by_global_norm",
+    "global_norm",
     "checkpoint",
     "flops",
     "metrics",
