@@ -61,6 +61,65 @@ def restore_orbax(path: str | Path, like: Any) -> tuple[Any, int]:
     return state["tree"], int(state["step"])
 
 
+class AsyncCheckpointer:
+    """Overlap checkpoint IO with training.
+
+    ``save()`` snapshots the pytree to host memory synchronously (cheap:
+    one device→host copy; on TPU this is the only part that must block
+    the step loop) and hands serialization + file IO to a background
+    thread.  The next ``save()``/``wait()`` joins the previous write
+    first, so at most one write is in flight and completed files appear
+    in submission order.  The written format is exactly `save`'s — the
+    two are interchangeable for `restore`.
+
+    Single-writer contract as `save` (process 0 writes; other processes'
+    calls are no-ops but still snapshot-free and cheap).  Always call
+    ``wait()`` (or use as a context manager) before reading the file or
+    exiting, and re-raise of background errors happens there.
+    """
+
+    def __init__(self):
+        self._thread = None
+        self._exc = None
+
+    def save(self, path: str | Path, tree: Any, *, step: int = 0) -> None:
+        import threading
+
+        self.wait()
+        if jax.process_index() != 0:
+            return
+        # Device→host transfer happens NOW (so the caller may freely
+        # donate/mutate device buffers); everything after runs off-thread.
+        host_tree = jax.tree.map(np.asarray, jax.device_get(tree))
+        self._exc = None
+
+        def _write():
+            try:
+                save(path, host_tree, step=step)
+            except BaseException as e:  # surfaced on wait()
+                self._exc = e
+
+        self._thread = threading.Thread(target=_write, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> None:
+        """Join the in-flight write (if any); re-raise its error here."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+            exc = getattr(self, "_exc", None)
+            self._exc = None
+            if exc is not None:
+                raise exc
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc_info):
+        self.wait()
+        return False
+
+
 def restore(path: str | Path, like: Any) -> tuple[Any, int]:
     """Restore into the structure of ``like`` (a template pytree with the
     same treedef, e.g. freshly-initialized params).  Returns

@@ -129,20 +129,22 @@ class Trainer:
             lambda params, state, x: model.apply(params, state, x, train=False)[0]
         )
 
-    def save(self, path, *, epoch: int = 0) -> None:
+    def save(self, path, *, epoch: int = 0, async_writer=None) -> None:
         """Checkpoint the full training state (params, model state,
-        optimizer) — single writer, replicas identical (SURVEY.md §5)."""
+        optimizer) — single writer, replicas identical (SURVEY.md §5).
+        With ``async_writer`` (a `checkpoint.AsyncCheckpointer`), the
+        file write overlaps subsequent training steps."""
         from tpu_dist.train import checkpoint
 
-        checkpoint.save(
-            path,
-            {
-                "params": self.params,
-                "model_state": self.model_state,
-                "opt_state": self.opt_state,
-            },
-            step=epoch,
-        )
+        tree = {
+            "params": self.params,
+            "model_state": self.model_state,
+            "opt_state": self.opt_state,
+        }
+        if async_writer is not None:
+            async_writer.save(path, tree, step=epoch)
+        else:
+            checkpoint.save(path, tree, step=epoch)
 
     def restore(self, path) -> int:
         """Restore state saved by `save`; returns the stored epoch index
@@ -173,7 +175,10 @@ class Trainer:
         """Run the training loop.
 
         ``start_epoch`` resumes mid-schedule (pair with `restore`);
-        ``checkpoint_dir`` writes ``ckpt_<epoch>.npz`` after each epoch;
+        ``checkpoint_dir`` writes ``ckpt_<epoch>.npz`` after each epoch —
+        asynchronously: the device→host snapshot is taken inline but the
+        file write overlaps the next epoch's steps (joined before `fit`
+        returns);
         ``trace_dir`` captures a jax.profiler trace of epoch
         ``start_epoch`` (perfetto-viewable — SURVEY.md §5 tracing);
         ``eval_dataset`` reports held-out accuracy after each epoch
@@ -194,6 +199,9 @@ class Trainer:
             )
         history = []
         step_key = jax.random.key(cfg.seed + 1)
+        from tpu_dist.train.checkpoint import AsyncCheckpointer
+
+        ckpt_writer = AsyncCheckpointer() if checkpoint_dir is not None else None
         for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
             t0 = time.perf_counter()
             total_loss, num_batches = 0.0, 0
@@ -235,8 +243,11 @@ class Trainer:
             history.append(EpochStats(epoch, mean_loss, dt, sps, acc))
             if checkpoint_dir is not None:
                 self.save(
-                    f"{checkpoint_dir}/ckpt_{epoch}.npz", epoch=epoch + 1
+                    f"{checkpoint_dir}/ckpt_{epoch}.npz", epoch=epoch + 1,
+                    async_writer=ckpt_writer,
                 )
+        if ckpt_writer is not None:
+            ckpt_writer.wait()
         return history
 
     def evaluate(self, dataset, *, batch_size: int = 1024) -> float:
