@@ -115,3 +115,78 @@ def test_capacity_drops_overflow():
 
     dropped = np.asarray(run(fn, gate_w, stacked, xs, world=N))
     assert dropped.max() > 0.0
+
+
+def test_top2_equals_weighted_pair_of_experts_when_capacity_ample():
+    """With 2 experts, top-2 routes EVERY token to both experts, so the
+    output must equal g1*E1(x) + g2*E2(x) computed densely."""
+    from tpu_dist.parallel.moe import moe_mlp_top2
+
+    n, d, h, t = 2, 8, 16, 10
+    key = jax.random.key(1)
+    kg, kx, k1, k2, k3, k4 = jax.random.split(key, 6)
+    gate_w = jax.random.normal(kg, (d, n))
+    ups = jnp.stack([jax.random.normal(k1, (d, h)), jax.random.normal(k2, (d, h))]) / np.sqrt(d)
+    downs = jnp.stack([jax.random.normal(k3, (h, d)), jax.random.normal(k4, (h, d))]) / np.sqrt(h)
+    xs = jax.random.normal(kx, (n, t, d))
+
+    def fn(gate_w, ups, downs, xs):
+        r = comm.rank()
+        x = jax.lax.dynamic_index_in_dim(xs, r, 0, keepdims=False)
+        up = jax.lax.dynamic_index_in_dim(ups, r, 0, keepdims=False)
+        down = jax.lax.dynamic_index_in_dim(downs, r, 0, keepdims=False)
+        y, stats = moe_mlp_top2(
+            x, gate_w, up, down, axis_name=comm.DEFAULT_AXIS,
+            capacity_factor=float(n),  # ample: every token fits twice
+        )
+        return y, stats["balance_loss"], stats["dropped_fraction"]
+
+    y, balance, dropped = run(fn, gate_w, ups, downs, xs, world=n)
+    assert float(np.asarray(dropped).max()) == 0.0
+
+    for r in range(n):
+        x = np.asarray(xs[r])
+        scores = x @ np.asarray(gate_w)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        order = np.argsort(-p, axis=-1)
+        e1, e2 = order[:, 0], order[:, 1]
+        p1 = np.take_along_axis(p, e1[:, None], 1)[:, 0]
+        p2 = np.take_along_axis(p, e2[:, None], 1)[:, 0]
+        g1, g2 = p1 / (p1 + p2), p2 / (p1 + p2)
+        want = np.zeros_like(x)
+        for i in range(t):
+            def expert(e, v):
+                hdn = np.asarray(jax.nn.gelu(jnp.asarray(v @ np.asarray(ups[e]))))
+                return hdn @ np.asarray(downs[e])
+            want[i] = g1[i] * expert(int(e1[i]), x[i]) + g2[i] * expert(int(e2[i]), x[i])
+        np.testing.assert_allclose(np.asarray(y[r]), want, rtol=1e-4, atol=1e-5)
+
+
+def test_top2_balance_loss_orders_routers():
+    """A router that sends everything to one expert must score a higher
+    balance loss than a near-uniform one."""
+    from tpu_dist.parallel.moe import moe_mlp_top2
+
+    n, d, h, t = 4, 8, 16, 16
+    xs = jax.random.normal(jax.random.key(0), (n, t, d))
+    ups = jnp.zeros((n, d, h))
+    downs = jnp.zeros((n, h, d))
+    skewed = jnp.zeros((d, n)).at[:, 0].set(5.0)  # everything -> expert 0
+    mild = jax.random.normal(jax.random.key(2), (d, n)) * 0.01
+
+    def fn(gate_w, xs):
+        r = comm.rank()
+        x = jax.lax.dynamic_index_in_dim(xs, r, 0, keepdims=False)
+        up = jnp.zeros((d, h))
+        down = jnp.zeros((h, d))
+        _, stats = moe_mlp_top2(
+            x, gate_w, up, down, axis_name=comm.DEFAULT_AXIS
+        )
+        return stats["balance_loss"]
+
+    b_skew = float(np.asarray(run(fn, skewed, xs, world=n)).mean())
+    b_mild = float(np.asarray(run(fn, mild, xs, world=n)).mean())
+    assert b_skew > b_mild
+    # near-uniform routing sits near the perfect-balance value of 1.0
+    np.testing.assert_allclose(b_mild, 1.0, atol=0.2)

@@ -21,6 +21,7 @@ from tpu_dist.parallel.ring_attention import (
 from tpu_dist.parallel.moe import (
     EXPERT_AXIS,
     moe_mlp,
+    moe_mlp_top2,
     stack_expert_params,
 )
 from tpu_dist.parallel.pipeline import (
@@ -69,6 +70,7 @@ __all__ = [
     "interleaved_bubble_fraction",
     "interleaved_ticks",
     "moe_mlp",
+    "moe_mlp_top2",
     "pipeline_apply",
     "pipeline_apply_interleaved",
     "stack_chunk_params",

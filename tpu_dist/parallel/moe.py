@@ -40,6 +40,38 @@ def capacity_for(tokens_per_rank: int, n_experts: int, factor: float = 1.25) -> 
     return max(1, math.ceil(tokens_per_rank / n_experts * factor))
 
 
+def _dispatch_process_combine(
+    xv, assign, gate, w_up, w_down, axis_name, cap, activation
+):
+    """Shared MoE transport: pack ``(R, d)`` virtual tokens into the
+    ``(n_experts, cap, d)`` dispatch buffer (cumulative-count slots,
+    overflow dropped), ship with ONE all_to_all each way, run the local
+    expert MLP, and return each virtual token's gated output (zeros when
+    dropped) plus kept mask and per-expert load."""
+    n = lax.axis_size(axis_name)
+    d = xv.shape[-1]
+    onehot = jax.nn.one_hot(assign, n, dtype=jnp.int32)  # (R, n)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1
+    pos_in_expert = pos.max(axis=1)  # (R,)
+    kept = pos_in_expert < cap
+    load = onehot.sum(axis=0)
+
+    dispatch = jnp.zeros((n, cap, d), xv.dtype)
+    dispatch = dispatch.at[
+        assign, jnp.clip(pos_in_expert, 0, cap - 1)
+    ].add(jnp.where(kept[:, None], xv, 0.0))
+
+    arriving = all_to_all(dispatch, axis_name, split_axis=0, concat_axis=0)
+    flat = arriving.reshape(n * cap, d)
+    hidden = activation(flat @ w_up)
+    processed = (hidden @ w_down).reshape(n, cap, d)
+    returned = all_to_all(processed, axis_name, split_axis=0, concat_axis=0)
+
+    out_v = returned[assign, jnp.clip(pos_in_expert, 0, cap - 1)]
+    yv = jnp.where(kept[:, None], out_v * gate[:, None], 0.0)
+    return yv, kept, load
+
+
 def moe_mlp(
     x: jax.Array,
     gate_w: jax.Array,
@@ -71,34 +103,66 @@ def moe_mlp(
     assign = jnp.argmax(scores, axis=-1)  # (T,)
     gate = jnp.take_along_axis(probs, assign[:, None], axis=1)[:, 0]
 
-    onehot = jax.nn.one_hot(assign, n, dtype=jnp.int32)  # (T, n)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # (T, n), -1 elsewhere
-    pos_in_expert = pos.max(axis=1)  # (T,)
-    kept = pos_in_expert < cap
-    load = onehot.sum(axis=0)  # tokens per expert from this rank
-
-    # Pack: dispatch[e, c] = the token assigned to expert e at slot c.
-    dispatch = jnp.zeros((n, cap, d), x.dtype)
-    dispatch = dispatch.at[
-        assign, jnp.clip(pos_in_expert, 0, cap - 1)
-    ].add(jnp.where(kept[:, None], x, 0.0))
-
-    # Ship: row e -> rank e.  Arrives as (n_src, cap, d) stacked by source.
-    arriving = all_to_all(dispatch, axis_name, split_axis=0, concat_axis=0)
-    flat = arriving.reshape(n * cap, d)
-    hidden = activation(flat @ w_up)
-    processed = (hidden @ w_down).reshape(n, cap, d)
-
-    # Ship back: row s of the result returns to source rank s, stacked by
-    # expert again: returned[e, c] = expert e's output for my slot c.
-    returned = all_to_all(processed, axis_name, split_axis=0, concat_axis=0)
-
-    # Combine into original token positions.
-    out_tokens = returned[assign, jnp.clip(pos_in_expert, 0, cap - 1)]
-    y = jnp.where(kept[:, None], out_tokens * gate[:, None], 0.0)
+    y, kept, load = _dispatch_process_combine(
+        x, assign, gate, w_up, w_down, axis_name, cap, activation
+    )
     stats = {
-        "dropped_fraction": 1.0 - kept.mean(),
+        "dropped_fraction": jnp.mean(~kept),
         "local_load": load,
+    }
+    return y, stats
+
+
+def moe_mlp_top2(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    axis_name: str = EXPERT_AXIS,
+    capacity_factor: float = 2.0,
+    activation=jax.nn.gelu,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Top-2 MoE MLP (GShard-style) inside shard_map over ``axis_name``.
+
+    Each token is sent to its two highest-probability experts with
+    combine weights renormalized over the pair (``g1 + g2 = 1``).  The
+    token's two placements are packed as ``2T`` virtual tokens — all
+    first choices before all second choices, so first choices win
+    capacity — through the same single-all_to_all-each-way transport as
+    `moe_mlp`.  Default ``capacity_factor`` doubles to hold the second
+    copies.
+
+    ``stats`` additionally carries ``balance_loss``: the Switch/GShard
+    load-balancing auxiliary ``n · Σ_e f_e · P_e`` (``f_e`` = fraction of
+    tokens whose FIRST choice is e, ``P_e`` = mean router probability) —
+    1.0 at perfect balance; add ``pmean(balance_loss) · λ`` to the
+    training loss to keep experts utilized.
+    """
+    n = lax.axis_size(axis_name)
+    T, d = x.shape
+    cap = capacity_for(T, n, capacity_factor)
+
+    scores = x @ gate_w
+    probs = jax.nn.softmax(scores, axis=-1)
+    top2_p, top2_e = lax.top_k(probs, 2)  # (T, 2)
+    gates = top2_p / jnp.maximum(top2_p.sum(-1, keepdims=True), 1e-9)
+
+    assign = jnp.concatenate([top2_e[:, 0], top2_e[:, 1]])  # (2T,)
+    gate = jnp.concatenate([gates[:, 0], gates[:, 1]])
+    xv = jnp.concatenate([x, x], axis=0)
+
+    yv, kept, load = _dispatch_process_combine(
+        xv, assign, gate, w_up, w_down, axis_name, cap, activation
+    )
+    y = yv[:T] + yv[T:]
+
+    f = jax.nn.one_hot(top2_e[:, 0], n, dtype=jnp.float32).mean(axis=0)
+    balance = n * jnp.sum(f * probs.mean(axis=0))
+    stats = {
+        "dropped_fraction": jnp.mean(~kept),
+        "local_load": load,
+        "balance_loss": balance,
     }
     return y, stats
 
