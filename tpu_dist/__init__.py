@@ -29,13 +29,14 @@ hand-rolled ring allreduce             ``parallel.ring_all_reduce`` (+ chunked)
 =====================================  ========================================
 """
 
-from tpu_dist import comm, data, models, nn, ops, parallel, train, utils
+from tpu_dist import comm, data, export, models, nn, ops, parallel, train, utils
 
 __version__ = "0.1.0"
 
 __all__ = [
     "comm",
     "data",
+    "export",
     "models",
     "nn",
     "ops",

@@ -1,0 +1,100 @@
+"""Ahead-of-time model export (serving artifacts).
+
+No reference analog (the 2017 tutorial stops at training,
+train_dist.py:103-127) — provided because a complete framework needs a
+deployment story.  The TPU-native form is `jax.export`: the jitted
+computation lowers to serialized StableHLO with the weights embedded as
+constants, producing ONE self-contained artifact that any later JAX
+process (same or different host type) can deserialize and call without
+the model code, the parameter files, or retracing.
+
+- `export_forward(model, params, state, in_shape, batch, path=)`:
+  inference forward (``train=False``) over a fixed batch shape.
+- `export_generate(lm, params, prompt_shape, steps, path=, ...)`:
+  the KV-cache decode loop (`TransformerLM.generate`) — prefill +
+  scanned sampling compiled into the artifact.
+- `load(path_or_bytes)`: returns a plain callable.
+
+Artifacts are platform-checked at call time by jax.export itself
+(export on CPU runs on CPU; export under a TPU backend for TPU
+serving); shapes are static — pad inputs to the exported batch.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import export as jexport
+
+
+def _serialize(jitted, args_spec, path: str | Path | None):
+    exp = jexport.export(jitted)(*args_spec)
+    blob = exp.serialize()
+    if path is not None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        Path(path).write_bytes(blob)
+    return blob
+
+
+def export_forward(
+    model,
+    params: Any,
+    state: Any,
+    in_shape: tuple[int, ...],
+    batch: int = 8,
+    *,
+    path: str | Path | None = None,
+    dtype=jnp.float32,
+) -> bytes:
+    """Serialize the inference forward ``x -> scores`` with weights
+    embedded.  Returns the artifact bytes (also written to ``path``)."""
+
+    @jax.jit
+    def forward(x):
+        scores, _ = model.apply(params, state, x, train=False)
+        return scores
+
+    spec = jax.ShapeDtypeStruct((batch,) + tuple(in_shape), dtype)
+    return _serialize(forward, (spec,), path)
+
+
+def export_generate(
+    lm,
+    params: Any,
+    prompt_shape: tuple[int, int],
+    steps: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    path: str | Path | None = None,
+) -> bytes:
+    """Serialize the LM's KV-cache decode: ``(prompt, key) -> tokens``.
+    Prompt shape ``(batch, prompt_len)`` and ``steps`` are baked in
+    (static shapes); sampling randomness stays a runtime input."""
+
+    @jax.jit
+    def gen_seeded(prompt, seed):
+        return lm.generate(
+            params, prompt, steps, key=jax.random.key(seed),
+            temperature=temperature, top_k=top_k,
+        )
+
+    spec = (
+        jax.ShapeDtypeStruct(tuple(prompt_shape), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.uint32),
+    )
+    return _serialize(gen_seeded, spec, path)
+
+
+def load(artifact: str | Path | bytes) -> Callable:
+    """Deserialize an exported artifact into a plain callable."""
+    blob = (
+        artifact
+        if isinstance(artifact, (bytes, bytearray))
+        else Path(artifact).read_bytes()
+    )
+    exp = jexport.deserialize(bytes(blob))
+    return lambda *args: exp.call(*args)
