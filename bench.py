@@ -107,12 +107,18 @@ def bench_tpu_dist() -> tuple[float, dict]:
         f"step flops={step_flops:.3e}, achieved {achieved / 1e12:.4f} TFLOP/s"
         + (f", MFU {util:.2%}" if util is not None else " (no peak for this platform)")
     )
-    return sps, {
+    extras = {
         "tflops": round(achieved / 1e12, 4),
         "mfu": round(util, 4) if util is not None else None,
         "flops_source": flops_source,
         "platform": devs[0].platform,
     }
+    from tpu_dist.train import metrics as metrics_mod
+
+    mem = metrics_mod.device_memory_stats(devs[0])
+    if mem and mem.get("peak_bytes_in_use"):
+        extras["hbm_peak_mb"] = round(mem["peak_bytes_in_use"] / 1e6, 1)
+    return sps, extras
 
 
 def bench_torch_reference() -> float:

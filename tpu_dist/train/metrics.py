@@ -69,3 +69,39 @@ def block_until_ready(tree):
     for leaf in jax.tree.leaves(tree):
         leaf.block_until_ready()
     return tree
+
+
+def device_memory_stats(device=None) -> dict | None:
+    """Live HBM statistics for one device (``bytes_in_use``,
+    ``peak_bytes_in_use``, ``bytes_limit``, ...) or None where the
+    backend doesn't track them (CPU-sim).  The `watch nvidia-smi` analog
+    (tuto.md:381), pulled from the runtime instead of a side tool."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else None
+
+
+def compiled_memory_analysis(fn, *args) -> dict | None:
+    """Compile ``fn`` for ``args`` and report XLA's memory plan:
+    argument/output/temp/code sizes in bytes.  Works on every backend
+    (it's a compile-time property), so HBM footprints are checkable on
+    the CPU-sim mesh before a chip is ever involved — e.g. asserting
+    that remat or accum_steps actually shrinks temp memory."""
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    return {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+    }
