@@ -270,3 +270,30 @@ def test_fit_checkpoints_are_restorable_after_async_write(tmp_path, mesh, datase
     assert resume == 2
     for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_optax_adapter_trains_and_checkpoints(tmp_path, mesh, dataset):
+    """Any optax transformation drops into the Trainer via from_optax;
+    its state checkpoints/restores like native optimizer state."""
+    import numpy as np
+    import optax
+
+    from tpu_dist import models, train
+
+    opt = train.from_optax(optax.chain(
+        optax.clip_by_global_norm(1.0), optax.adam(1e-3)
+    ))
+    cfg = train.TrainConfig(log=lambda s: None, global_batch=32)
+    t = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, cfg, optimizer=opt
+    )
+    hist = t.fit(dataset, epochs=2, checkpoint_dir=str(tmp_path))
+    assert np.isfinite(hist[-1].mean_loss)
+    assert hist[-1].mean_loss < hist[0].mean_loss * 1.2  # training moves
+
+    b = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, cfg, optimizer=opt
+    )
+    assert b.restore(tmp_path / "ckpt_1.npz") == 2
+    for x, y in zip(jax.tree.leaves(t.opt_state), jax.tree.leaves(b.opt_state)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -5,6 +5,7 @@ from tpu_dist.train.optim import (
     Optimizer,
     adamw,
     clip_by_global_norm,
+    from_optax,
     global_norm,
     sgd,
 )
@@ -17,6 +18,7 @@ __all__ = [
     "Trainer",
     "adamw",
     "clip_by_global_norm",
+    "from_optax",
     "global_norm",
     "checkpoint",
     "flops",

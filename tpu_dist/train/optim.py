@@ -142,3 +142,21 @@ def clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
         return optimizer.update(params, grads, state)
 
     return Optimizer(optimizer.init, update)
+
+
+def from_optax(tx) -> Optimizer:
+    """Adapt an optax ``GradientTransformation`` to this framework's
+    `Optimizer` (init/update) contract, so the whole optax catalog drops
+    into `make_train_step` / `Trainer` / FSDP unchanged.  State is the
+    optax state pytree — checkpointable like any other."""
+
+    def init(params):
+        return tx.init(params)
+
+    def update(params, grads, state):
+        updates, new_state = tx.update(grads, state, params)
+        import optax
+
+        return optax.apply_updates(params, updates), new_state
+
+    return Optimizer(init, update)
