@@ -1,0 +1,87 @@
+"""Autoregressive decode throughput: tokens/s through the KV-cache path.
+
+Measures `TransformerLM.generate` (prefill + scanned single-token steps)
+at a few batch sizes, reporting decode tokens/s and ms/token — the
+serving-side counterpart of the training benches.  Decode is memory-bound
+(every step re-reads the KV cache + weights), so this is the HBM
+bandwidth probe among the benchmarks.
+
+Run: ``python benchmarks/decode.py [--platform cpu]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--depth", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--prompt", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=128)
+    ap.add_argument("--max-seq", type=int, default=512)
+    ap.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        from tpu_dist.utils.platform import pin_cpu
+
+        pin_cpu()
+    elif args.platform is None:
+        from tpu_dist.utils.platform import pin_cpu_if_backend_dead
+
+        pin_cpu_if_backend_dead()
+
+    import jax
+
+    from tpu_dist import models
+
+    dev = jax.devices()[0]
+    print(f"backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+    lm = models.TransformerLM(
+        vocab=args.vocab, dim=args.dim, depth=args.depth,
+        heads=args.heads, max_seq=args.max_seq,
+    )
+    params, _ = lm.init(jax.random.key(0))
+    rows = []
+    for b in args.batches:
+        prompt = jax.random.randint(
+            jax.random.key(1), (b, args.prompt), 0, args.vocab
+        )
+        gen = jax.jit(functools.partial(lm.generate, steps=args.steps))
+        out = jax.block_until_ready(gen(params, prompt))  # compile
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(gen(params, prompt))
+        dt = time.perf_counter() - t0
+        toks = b * args.steps
+        rows.append({
+            "batch": b,
+            "tokens_per_sec": round(toks / dt, 1),
+            "ms_per_token_step": round(dt / args.steps * 1e3, 3),
+        })
+        print(
+            f"batch {b:4d}: {toks / dt:10,.0f} tok/s  "
+            f"({dt / args.steps * 1e3:.2f} ms/step)",
+            file=sys.stderr,
+        )
+    print(json.dumps({
+        "metric": "lm_decode_tokens_per_sec",
+        "platform": dev.platform,
+        "model": f"dim{args.dim}xL{args.depth}h{args.heads}",
+        "prompt": args.prompt, "steps": args.steps,
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,6 +43,7 @@ for i in $(seq 1 "$MAX"); do
     run scaling_mnist 1200 python benchmarks/scaling.py --max-world 1
     run scaling_vit 1800 python benchmarks/scaling.py --max-world 1 --model vit --batch-per-chip 32 --steps 10
     run allreduce 900 python demos/allreduce.py --world 1 --bench 20 --mbytes 64
+    run decode 1200 python benchmarks/decode.py
     echo "[$(date +%T)] battery done ($FAILED failed) -> $OUTDIR" | tee -a "$OUTDIR/watch.log"
     [ "$FAILED" -eq 0 ] && exit 0
     exit 2
