@@ -154,3 +154,83 @@ def test_generate_under_data_parallel_sharding(lm, lm_params):
         jax.device_put(prompt, NamedSharding(mesh, P("data"))),
     )
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+class TestGQA:
+    """Grouped-query attention: fewer KV heads, smaller cache, same
+    decode contract."""
+
+    def _gqa_lm(self):
+        return models.TransformerLM(
+            vocab=64, dim=32, depth=2, heads=4, kv_heads=2, max_seq=32
+        )
+
+    def test_cache_has_kv_heads_only(self):
+        lm = self._gqa_lm()
+        cache = lm.init_cache(3)
+        assert cache[0]["k"].shape == (3, 2, 32, 8)  # kv_heads=2, hd=8
+
+    def test_gqa_decode_matches_dense_forward(self):
+        lm = self._gqa_lm()
+        params, _ = lm.init(jax.random.key(2))
+        tokens = models.synthetic_tokens(2, 10, 64, seed=6)
+        dense, _ = lm.apply(params, {}, tokens)
+        cache = lm.init_cache(2)
+        for t in range(10):
+            logits, cache = lm.apply_cached(
+                params, tokens[:, t : t + 1], cache, t
+            )
+            np.testing.assert_allclose(
+                np.asarray(dense[:, t]), np.asarray(logits[:, 0]), atol=1e-5
+            )
+
+    def test_gqa_equals_mha_with_repeated_kv_weights(self):
+        """kv_heads=2/heads=4 must equal an MHA whose K/V projection
+        weights repeat each kv head across its group."""
+        from tpu_dist import nn
+
+        dim, heads, kvh = 32, 4, 2
+        hd = dim // heads
+        gqa = nn.MultiHeadAttention(dim, heads, causal=True, kv_heads=kvh)
+        pg, _ = gqa.init(jax.random.key(5), (8, dim))
+        x = jax.random.normal(jax.random.key(6), (2, 8, dim))
+        want, _ = gqa.apply(pg, {}, x)
+
+        mha = nn.MultiHeadAttention(dim, heads, causal=True)
+        # build fused qkv weights from the GQA params: q as-is; k/v
+        # repeated per group
+        wq = pg["q"]["w"].reshape(dim, heads, hd)
+        bq = pg["q"]["b"].reshape(heads, hd)
+        wkv = pg["kv"]["w"].reshape(dim, 2, kvh, hd)
+        bkv = pg["kv"]["b"].reshape(2, kvh, hd)
+        g = heads // kvh
+        wk = jnp.repeat(wkv[:, 0], g, axis=1)
+        wv = jnp.repeat(wkv[:, 1], g, axis=1)
+        bk = jnp.repeat(bkv[0], g, axis=0)
+        bv = jnp.repeat(bkv[1], g, axis=0)
+        w_fused = jnp.stack([wq, wk, wv], axis=1).reshape(dim, 3 * dim)
+        b_fused = jnp.stack([bq, bk, bv], axis=0).reshape(3 * dim)
+        pm = {"qkv": {"w": w_fused, "b": b_fused}, "out": pg["out"]}
+        got, _ = mha.apply(pm, {}, x)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+        )
+
+    def test_gqa_greedy_generate_runs(self):
+        lm = self._gqa_lm()
+        params, _ = lm.init(jax.random.key(1))
+        prompt = models.synthetic_tokens(2, 3, 64, seed=0)
+        out = lm.generate(params, prompt, 5)
+        assert out.shape == (2, 5)
+
+    def test_invalid_kv_heads_raises(self):
+        from tpu_dist import nn
+
+        with pytest.raises(ValueError, match="kv_heads"):
+            nn.MultiHeadAttention(32, 4, kv_heads=3)
+
+    def test_seq_parallel_rejects_gqa(self):
+        lm = self._gqa_lm()
+        params, _ = lm.init(jax.random.key(0))
+        with pytest.raises(ValueError, match="kv_heads == heads"):
+            lm.apply_seq_parallel(params, jnp.zeros((1, 4), jnp.int32), "seq")

@@ -35,14 +35,17 @@ class TransformerLM(Module):
         depth: int = 4,
         heads: int = 4,
         max_seq: int = 1024,
+        kv_heads: int | None = None,
     ):
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
+        self.kv_heads = heads if kv_heads is None else kv_heads
         self.max_seq = max_seq
         self.embed = nn.Embedding(vocab, dim)
         self.blocks = [
-            EncoderBlock(dim, heads, causal=True) for _ in range(depth)
+            EncoderBlock(dim, heads, causal=True, kv_heads=kv_heads)
+            for _ in range(depth)
         ]
         self.ln = nn.LayerNorm()
 
@@ -82,13 +85,14 @@ class TransformerLM(Module):
 
     def init_cache(self, batch: int, cache_len: int | None = None, dtype=None):
         """Static-shape KV cache: one ``{"k", "v"}`` pair per block, each
-        ``(batch, heads, cache_len, head_dim)``.  Allocated once and
-        updated in place (``dynamic_update_slice``) so every decode step
-        reuses one compiled program."""
+        ``(batch, kv_heads, cache_len, head_dim)`` (GQA models cache only
+        their kv heads).  Allocated once and updated in place
+        (``dynamic_update_slice``) so every decode step reuses one
+        compiled program."""
         L = cache_len or self.max_seq
         hd = self.dim // self.heads
         dt = dtype or jnp.float32
-        z = jnp.zeros((batch, self.heads, L, hd), dt)
+        z = jnp.zeros((batch, self.kv_heads, L, hd), dt)
         return [{"k": z, "v": z} for _ in self.blocks]
 
     def apply_cached(self, params, tokens, cache, index):
@@ -194,6 +198,11 @@ class TransformerLM(Module):
 
         from tpu_dist.parallel.ring_attention import RingMultiHeadAttention
 
+        if self.kv_heads != self.heads:
+            raise ValueError(
+                "apply_seq_parallel requires kv_heads == heads (the ring "
+                "attention core uses the fused-QKV layout)"
+            )
         b, s_local = tokens_local.shape
         n = lax.axis_size(axis_name)
         if n * s_local > self.max_seq:

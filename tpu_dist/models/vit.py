@@ -38,9 +38,12 @@ class MLP(Module):
 class EncoderBlock(Module):
     """Pre-norm transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
 
-    def __init__(self, dim: int, heads: int, mlp_ratio: int = 4, *, causal: bool = False):
+    def __init__(self, dim: int, heads: int, mlp_ratio: int = 4, *,
+                 causal: bool = False, kv_heads: int | None = None):
         self.ln1 = nn.LayerNorm()
-        self.attn = nn.MultiHeadAttention(dim, heads, causal=causal)
+        self.attn = nn.MultiHeadAttention(
+            dim, heads, causal=causal, kv_heads=kv_heads
+        )
         self.ln2 = nn.LayerNorm()
         self.mlp = MLP(dim, dim * mlp_ratio)
 

@@ -66,32 +66,81 @@ def dot_product_attention(
 
 
 class MultiHeadAttention(Module):
-    """Standard MHA block over (batch, seq, dim) inputs."""
+    """Standard MHA block over (batch, seq, dim) inputs.
 
-    def __init__(self, dim: int, heads: int, *, causal: bool = False):
+    ``kv_heads`` enables grouped-query attention (GQA): fewer key/value
+    heads than query heads, each shared by ``heads // kv_heads`` query
+    heads.  The KV cache shrinks by the same factor — the reason GQA is
+    the modern long-context inference layout (``kv_heads=1`` is
+    multi-query attention).  With ``kv_heads == heads`` (default) the
+    layer is exactly the classic fused-QKV MHA, param structure and all.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        heads: int,
+        *,
+        causal: bool = False,
+        kv_heads: int | None = None,
+    ):
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
         self.dim = dim
         self.heads = heads
         self.head_dim = dim // heads
         self.causal = causal
-        self._qkv = Dense(3 * dim)
+        self.kv_heads = heads if kv_heads is None else kv_heads
+        if self.kv_heads < 1 or heads % self.kv_heads:
+            raise ValueError(
+                f"heads {heads} not divisible by kv_heads {self.kv_heads}"
+            )
+        self.group = heads // self.kv_heads
+        if self.group == 1:
+            self._qkv = Dense(3 * dim)
+        else:
+            self._q = Dense(dim)
+            self._kv = Dense(2 * self.kv_heads * self.head_dim)
         self._out = Dense(dim)
 
     def init(self, key, input_shape):
-        k1, k2 = jax.random.split(key)
-        pq, _ = self._qkv.init(k1, input_shape)
-        po, _ = self._out.init(k2, input_shape[:-1] + (self.dim,))
-        return {"qkv": pq, "out": po}, {}
+        k1, k2, k3 = jax.random.split(key, 3)
+        po, _ = self._out.init(k3, input_shape[:-1] + (self.dim,))
+        if self.group == 1:
+            pq, _ = self._qkv.init(k1, input_shape)
+            return {"qkv": pq, "out": po}, {}
+        pq, _ = self._q.init(k1, input_shape)
+        pkv, _ = self._kv.init(k2, input_shape)
+        return {"q": pq, "kv": pkv, "out": po}, {}
+
+    def _project(self, params, x):
+        """-> q (b, heads, s, hd), k/v (b, kv_heads, s, hd)."""
+        b, s, _ = x.shape
+        if self.group == 1:
+            qkv, _ = self._qkv.apply(params["qkv"], {}, x)
+            qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
+            q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+            return q, k, v
+        q, _ = self._q.apply(params["q"], {}, x)
+        q = jnp.moveaxis(q.reshape(b, s, self.heads, self.head_dim), 1, 2)
+        kv, _ = self._kv.apply(params["kv"], {}, x)
+        kv = kv.reshape(b, s, 2, self.kv_heads, self.head_dim)
+        k, v = (jnp.moveaxis(kv[:, :, i], 1, 2) for i in range(2))
+        return q, k, v
+
+    def _expand_kv(self, t):
+        """Repeat each kv head across its query-head group (XLA folds the
+        broadcast into the batched matmul; nothing materializes in HBM)."""
+        if self.group == 1:
+            return t
+        return jnp.repeat(t, self.group, axis=1)
 
     def apply(self, params, state, x, *, train=False, key=None):
         b, s, _ = x.shape
-        qkv, _ = self._qkv.apply(params["qkv"], {}, x)
-        qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
-        q, k, v = (
-            jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3)
-        )  # (b, h, s, hd)
-        o = dot_product_attention(q, k, v, causal=self.causal)
+        q, k, v = self._project(params, x)
+        o = dot_product_attention(
+            q, self._expand_kv(k), self._expand_kv(v), causal=self.causal
+        )
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
         y, _ = self._out.apply(params["out"], {}, o)
         return y, state
@@ -101,11 +150,13 @@ class MultiHeadAttention(Module):
 
         ``x`` holds ``s`` NEW tokens whose global positions start at
         ``index`` (a traced scalar is fine); their keys/values are written
-        into the static-shape caches ``(b, heads, cache_len, head_dim)``
+        into the static-shape caches ``(b, kv_heads, cache_len, head_dim)``
         with ``dynamic_update_slice`` and the queries attend over the
         whole cache under a position mask (``pos <= index + q_offset``) —
         static shapes throughout, so one compiled program serves every
-        decode step.  Returns ``(y, k_cache, v_cache)``.
+        decode step.  Under GQA the cache carries only ``kv_heads`` heads
+        (``heads / kv_heads``× less decode HBM traffic).  Returns
+        ``(y, k_cache, v_cache)``.
 
         Only meaningful for causal self-attention (decode IS causal);
         raises otherwise to catch ViT-style misuse.
@@ -115,9 +166,7 @@ class MultiHeadAttention(Module):
         from jax import lax
 
         b, s, _ = x.shape
-        qkv, _ = self._qkv.apply(params["qkv"], {}, x)
-        qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
-        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        q, k, v = self._project(params, x)
         k_cache = lax.dynamic_update_slice_in_dim(
             k_cache, k.astype(k_cache.dtype), index, axis=2
         )
@@ -127,13 +176,19 @@ class MultiHeadAttention(Module):
         cache_len = k_cache.shape[2]
         scale = self.head_dim**-0.5
         logits = jnp.einsum(
-            "bhqd,bhkd->bhqk", q * scale, k_cache.astype(q.dtype)
+            "bhqd,bhkd->bhqk",
+            q * scale,
+            self._expand_kv(k_cache).astype(q.dtype),
         )
         pos = jnp.arange(cache_len)[None, :]
         qpos = index + jnp.arange(s)[:, None]
         logits = jnp.where(pos <= qpos, logits, -1e30)
         weights = jax.nn.softmax(logits, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", weights, v_cache.astype(q.dtype))
+        o = jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            weights,
+            self._expand_kv(v_cache).astype(q.dtype),
+        )
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
         y, _ = self._out.apply(params["out"], {}, o)
         return y, k_cache, v_cache

@@ -117,6 +117,11 @@ def tp_attention(
     r = lax.axis_index(axis_name)
     if heads % n:
         raise ValueError(f"heads {heads} not divisible by axis size {n}")
+    if "qkv" not in attn_params:
+        raise ValueError(
+            "tp_attention requires the fused-QKV layout (kv_heads == "
+            "heads); GQA param trees are not supported here yet"
+        )
     hl = heads // n
     w = attn_params["qkv"]["w"]
     d = w.shape[0]
