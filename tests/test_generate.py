@@ -234,3 +234,72 @@ class TestGQA:
         params, _ = lm.init(jax.random.key(0))
         with pytest.raises(ValueError, match="kv_heads == heads"):
             lm.apply_seq_parallel(params, jnp.zeros((1, 4), jnp.int32), "seq")
+
+
+class TestRope:
+    """Rotary positions: relative-distance property + decode equivalence."""
+
+    def test_qk_product_depends_only_on_relative_distance(self):
+        from tpu_dist import nn
+
+        hd = 16
+        q = jax.random.normal(jax.random.key(0), (1, 2, 1, hd))
+        k = jax.random.normal(jax.random.key(1), (1, 2, 1, hd))
+
+        def score(qpos, kpos):
+            qr = nn.rope(q, jnp.array([qpos]))
+            kr = nn.rope(k, jnp.array([kpos]))
+            return np.asarray(jnp.einsum("bhqd,bhkd->bhqk", qr, kr))
+
+        np.testing.assert_allclose(score(7, 3), score(107, 103), atol=1e-4)
+        # and it DOES vary with relative distance
+        assert not np.allclose(score(7, 3), score(7, 5), atol=1e-3)
+
+    def test_rope_lm_decode_matches_dense(self):
+        lm = models.TransformerLM(
+            vocab=64, dim=32, depth=2, heads=4, max_seq=32,
+            pos_embedding="rope",
+        )
+        params, _ = lm.init(jax.random.key(4))
+        assert "pos" not in params  # no learned table
+        tokens = models.synthetic_tokens(2, 9, 64, seed=8)
+        dense, _ = lm.apply(params, {}, tokens)
+        cache = lm.init_cache(2)
+        for t in range(9):
+            logits, cache = lm.apply_cached(
+                params, tokens[:, t : t + 1], cache, t
+            )
+            np.testing.assert_allclose(
+                np.asarray(dense[:, t]), np.asarray(logits[:, 0]), atol=1e-5
+            )
+
+    def test_rope_lm_trains_and_generates(self):
+        lm = models.TransformerLM(
+            vocab=64, dim=32, depth=1, heads=4, max_seq=64,
+            pos_embedding="rope",
+        )
+        tokens = models.synthetic_tokens(32, 16, 64)
+        params, _ = lm.init(jax.random.key(0))
+
+        def loss_fn(p):
+            logits, _ = lm.apply(p, {}, tokens)
+            return models.lm_loss(logits, tokens)
+
+        step = jax.jit(jax.value_and_grad(loss_fn))
+        l0 = float(loss_fn(params))
+        for _ in range(60):
+            l, g = step(params)
+            params = jax.tree.map(lambda p, g_: p - 0.3 * g_, params, g)
+        assert float(l) < l0 * 0.7
+        out = lm.generate(params, tokens[:2, :3], 5)
+        assert out.shape == (2, 5)
+
+    def test_invalid_pos_embedding_raises(self):
+        with pytest.raises(ValueError, match="pos_embedding"):
+            models.TransformerLM(pos_embedding="alibi")
+
+    def test_odd_head_dim_rejected(self):
+        from tpu_dist import nn
+
+        with pytest.raises(ValueError, match="even head_dim"):
+            nn.MultiHeadAttention(6, 2, use_rope=True)  # head_dim 3

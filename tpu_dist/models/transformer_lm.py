@@ -36,15 +36,25 @@ class TransformerLM(Module):
         heads: int = 4,
         max_seq: int = 1024,
         kv_heads: int | None = None,
+        pos_embedding: str = "learned",
     ):
+        if pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding must be 'learned' or 'rope', got "
+                f"{pos_embedding!r}"
+            )
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
         self.kv_heads = heads if kv_heads is None else kv_heads
         self.max_seq = max_seq
+        self.pos_embedding = pos_embedding
         self.embed = nn.Embedding(vocab, dim)
         self.blocks = [
-            EncoderBlock(dim, heads, causal=True, kv_heads=kv_heads)
+            EncoderBlock(
+                dim, heads, causal=True, kv_heads=kv_heads,
+                use_rope=pos_embedding == "rope",
+            )
             for _ in range(depth)
         ]
         self.ln = nn.LayerNorm()
@@ -55,20 +65,25 @@ class TransformerLM(Module):
         tok_shape = (self.max_seq, self.dim)
         params = {
             "embed": self.embed.init(ks[0], ())[0],
-            "pos": jax.random.normal(ks[1], (1, self.max_seq, self.dim)) * 0.02,
             "blocks": [
                 blk.init(k, tok_shape)[0] for blk, k in zip(self.blocks, ks[2:])
             ],
             "ln": self.ln.init(ks[-1], tok_shape)[0],
         }
+        if self.pos_embedding == "learned":
+            params["pos"] = (
+                jax.random.normal(ks[1], (1, self.max_seq, self.dim)) * 0.02
+            )
         return params, {}
 
     def _trunk(self, params, tokens, *, pos_offset=0):
         b, s = tokens.shape
         h = params["embed"]["table"][tokens]
-        h = h + jax.lax.dynamic_slice_in_dim(
-            params["pos"], pos_offset, s, axis=1
-        )
+        if self.pos_embedding == "learned":
+            h = h + jax.lax.dynamic_slice_in_dim(
+                params["pos"], pos_offset, s, axis=1
+            )
+        # rope: positions enter inside attention (q/k rotation), not here
         return h
 
     def apply(self, params, state, tokens, *, train=False, key=None):
@@ -160,7 +175,7 @@ class TransformerLM(Module):
                 logits = jnp.where(logits < kth, -1e30, logits)
             return jax.random.categorical(k, logits).astype(prompt.dtype)
 
-        cache = self.init_cache(b, L, dtype=params["pos"].dtype)
+        cache = self.init_cache(b, L, dtype=params["embed"]["table"].dtype)
         logits, cache = self.apply_cached(params, prompt, cache, 0)
         last = logits[:, -1]
 
@@ -183,6 +198,11 @@ class TransformerLM(Module):
         `apply`; tests assert fp-tolerance agreement."""
         from tpu_dist.parallel.tensor_parallel import tp_encoder_block
 
+        if self.pos_embedding != "learned":
+            raise ValueError(
+                "apply_tensor_parallel supports learned positions only "
+                "(tp_attention does not apply rope)"
+            )
         h = self._trunk(params, tokens)
         for blk, pb in zip(self.blocks, params["blocks"]):
             h = tp_encoder_block(blk, pb, h, axis_name)
@@ -202,6 +222,11 @@ class TransformerLM(Module):
             raise ValueError(
                 "apply_seq_parallel requires kv_heads == heads (the ring "
                 "attention core uses the fused-QKV layout)"
+            )
+        if self.pos_embedding != "learned":
+            raise ValueError(
+                "apply_seq_parallel supports learned positions only (the "
+                "ring attention core does not apply rope)"
             )
         b, s_local = tokens_local.shape
         n = lax.axis_size(axis_name)

@@ -39,10 +39,11 @@ class EncoderBlock(Module):
     """Pre-norm transformer block: x + MHA(LN(x)); x + MLP(LN(x))."""
 
     def __init__(self, dim: int, heads: int, mlp_ratio: int = 4, *,
-                 causal: bool = False, kv_heads: int | None = None):
+                 causal: bool = False, kv_heads: int | None = None,
+                 use_rope: bool = False):
         self.ln1 = nn.LayerNorm()
         self.attn = nn.MultiHeadAttention(
-            dim, heads, causal=causal, kv_heads=kv_heads
+            dim, heads, causal=causal, kv_heads=kv_heads, use_rope=use_rope
         )
         self.ln2 = nn.LayerNorm()
         self.mlp = MLP(dim, dim * mlp_ratio)

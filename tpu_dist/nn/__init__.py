@@ -1,6 +1,6 @@
 """`tpu_dist.nn` — minimal functional module system + layer library."""
 
-from tpu_dist.nn.attention import MultiHeadAttention, dot_product_attention
+from tpu_dist.nn.attention import MultiHeadAttention, dot_product_attention, rope
 from tpu_dist.nn.core import Lambda, Module, Sequential, fanin_uniform
 from tpu_dist.nn.layers import (
     AvgPool2D,
@@ -34,6 +34,7 @@ __all__ = [
     "MaxPool2D",
     "Module",
     "MultiHeadAttention",
+    "rope",
     "Sequential",
     "accuracy",
     "cross_entropy",

@@ -65,6 +65,29 @@ def dot_product_attention(
     return jnp.einsum("...hqk,...hkd->...hqd", weights, v)
 
 
+def rope(x: jax.Array, positions: jax.Array, *, base: float = 10000.0):
+    """Rotary position embedding over ``(..., seq, head_dim)``.
+
+    Rotates each (even, odd-half) feature pair by an angle proportional
+    to the token's absolute position, so the q·k inner product depends
+    only on RELATIVE distance (tested) — the modern long-context
+    positional scheme (no learned table, extrapolates past training
+    lengths).  ``positions``: ``(seq,)`` absolute indices (traced values
+    fine, e.g. ``index + arange(s)`` during cached decode)."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope requires an even head_dim, got {d}")
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs  # (s, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
 class MultiHeadAttention(Module):
     """Standard MHA block over (batch, seq, dim) inputs.
 
@@ -83,6 +106,7 @@ class MultiHeadAttention(Module):
         *,
         causal: bool = False,
         kv_heads: int | None = None,
+        use_rope: bool = False,
     ):
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
@@ -90,6 +114,11 @@ class MultiHeadAttention(Module):
         self.heads = heads
         self.head_dim = dim // heads
         self.causal = causal
+        self.use_rope = use_rope
+        if use_rope and self.head_dim % 2:
+            raise ValueError(
+                f"rope requires an even head_dim, got {self.head_dim}"
+            )
         self.kv_heads = heads if kv_heads is None else kv_heads
         if self.kv_heads < 1 or heads % self.kv_heads:
             raise ValueError(
@@ -138,6 +167,9 @@ class MultiHeadAttention(Module):
     def apply(self, params, state, x, *, train=False, key=None):
         b, s, _ = x.shape
         q, k, v = self._project(params, x)
+        if self.use_rope:
+            pos = jnp.arange(s)
+            q, k = rope(q, pos), rope(k, pos)
         o = dot_product_attention(
             q, self._expand_kv(k), self._expand_kv(v), causal=self.causal
         )
@@ -167,6 +199,11 @@ class MultiHeadAttention(Module):
 
         b, s, _ = x.shape
         q, k, v = self._project(params, x)
+        if self.use_rope:
+            # keys enter the cache already rotated (their rotation is a
+            # pure function of their own absolute position)
+            pos = index + jnp.arange(s)
+            q, k = rope(q, pos), rope(k, pos)
         k_cache = lax.dynamic_update_slice_in_dim(
             k_cache, k.astype(k_cache.dtype), index, axis=2
         )
