@@ -62,3 +62,16 @@ def test_generate_follows_markov_chain():
     )
     acc = float(out.splitlines()[-1].split(":")[1].split("(")[0])
     assert acc >= 0.9, out
+
+
+def test_train_lm_on_real_text_corpus():
+    out = run_demo(
+        "train_lm.py", "--world", "2", "--platform", "cpu",
+        "--corpus", "../docs/tutorial.md", "--steps", "25",
+        "--batch", "16", "--seq", "64", timeout=400,
+    )
+    losses = [
+        float(l.rsplit("loss", 1)[1])
+        for l in out.splitlines() if l.lstrip().startswith("step")
+    ]
+    assert len(losses) > 2 and losses[-1] < losses[0], out

@@ -11,6 +11,7 @@ from tpu_dist.data.mnist import (
     synthetic_mnist,
 )
 from tpu_dist.data.partition import DataPartitioner, Partition, equal_shards
+from tpu_dist.data.text import VOCAB as TEXT_VOCAB, TextCorpus, load_text
 
 __all__ = [
     "DataPartitioner",
@@ -18,7 +19,10 @@ __all__ = [
     "DistributedLoader",
     "Loader",
     "Partition",
+    "TEXT_VOCAB",
+    "TextCorpus",
     "equal_shards",
+    "load_text",
     "load_cifar10",
     "load_idx_images",
     "load_idx_labels",

@@ -1,0 +1,81 @@
+"""Byte-level text corpus for language modeling.
+
+The reference's data layer stops at MNIST images (train_dist.py:74-91);
+the LM family needs a text path.  Byte-level tokenization (vocab 256)
+is the TPU-friendly choice: no tokenizer artifacts to ship, fully
+deterministic, any file is a corpus.  The corpus packs the raw bytes
+into fixed-length windows — static shapes for the compiled train step —
+and splits train/validation by windows, deterministically, so every
+host computes the same split with zero communication (the partitioner
+invariant, SURVEY.md §2c.6, extended to text).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+VOCAB = 256
+
+
+class TextCorpus:
+    """Fixed-window byte dataset over a text blob or file.
+
+    ``corpus[i] -> (seq_len,) int32`` token window (stride = seq_len,
+    non-overlapping).  Compatible with `DataPartitioner` /
+    `DistributedLoader` (len/getitem), and with
+    `models.lm_loss` (predict byte t+1 from t).
+    """
+
+    def __init__(self, text: str | bytes, seq_len: int):
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        if len(data) < seq_len + 1:
+            raise ValueError(
+                f"corpus of {len(data)} bytes is shorter than one "
+                f"window (seq_len={seq_len})"
+            )
+        self.seq_len = seq_len
+        arr = np.frombuffer(data, np.uint8).astype(np.int32)
+        n = len(arr) // seq_len
+        self._windows = arr[: n * seq_len].reshape(n, seq_len)
+
+    def __len__(self) -> int:
+        return len(self._windows)
+
+    def __getitem__(self, i: int):
+        return self._windows[i]
+
+    def decode(self, tokens) -> str:
+        """Bytes → text (lossy on invalid UTF-8 boundaries)."""
+        return bytes(np.asarray(tokens, np.uint8).tolist()).decode(
+            "utf-8", errors="replace"
+        )
+
+
+def load_text(
+    path: str | Path,
+    seq_len: int = 256,
+    *,
+    val_fraction: float = 0.0,
+    seed: int = 1234,
+):
+    """Load a text file as byte windows.  With ``val_fraction`` returns
+    ``(train, val)`` — windows shuffled by ``random.Random(seed)`` and
+    split, identically on every host (same contract as
+    `DataPartitioner`)."""
+    raw = Path(path).read_bytes()
+    corpus = TextCorpus(raw, seq_len)
+    if not val_fraction:
+        return corpus
+    import random
+
+    idx = list(range(len(corpus)))
+    random.Random(seed).shuffle(idx)
+    n_val = max(1, int(len(idx) * val_fraction))
+    from tpu_dist.data.partition import Partition
+
+    return (
+        Partition(corpus, idx[n_val:]),
+        Partition(corpus, idx[:n_val]),
+    )
