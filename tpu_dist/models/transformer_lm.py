@@ -223,11 +223,6 @@ class TransformerLM(Module):
                 "apply_seq_parallel requires kv_heads == heads (the ring "
                 "attention core uses the fused-QKV layout)"
             )
-        if self.pos_embedding != "learned":
-            raise ValueError(
-                "apply_seq_parallel supports learned positions only (the "
-                "ring attention core does not apply rope)"
-            )
         b, s_local = tokens_local.shape
         n = lax.axis_size(axis_name)
         if n * s_local > self.max_seq:
@@ -241,7 +236,8 @@ class TransformerLM(Module):
         # Same block math as `apply`, with the attention core swapped for
         # the ring module (identical param structure by construction).
         ring_mha = RingMultiHeadAttention(
-            self.dim, self.heads, axis_name=axis_name, causal=True
+            self.dim, self.heads, axis_name=axis_name, causal=True,
+            use_rope=self.pos_embedding == "rope",
         )
         for blk, pb in zip(self.blocks, params["blocks"]):
             x1, _ = blk.ln1.apply(pb["ln1"], {}, h)

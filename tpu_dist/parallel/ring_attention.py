@@ -129,12 +129,16 @@ class RingMultiHeadAttention:
     tests assert numerical agreement with the unsharded module.
     """
 
-    def __init__(self, dim: int, heads: int, *, axis_name: str, causal: bool = False):
+    def __init__(self, dim: int, heads: int, *, axis_name: str,
+                 causal: bool = False, use_rope: bool = False):
         from tpu_dist import nn  # local import: nn must not depend on parallel
 
         self.axis_name = axis_name
         self.causal = causal
-        self._dense = nn.MultiHeadAttention(dim, heads, causal=causal)
+        self.use_rope = use_rope
+        self._dense = nn.MultiHeadAttention(
+            dim, heads, causal=causal, use_rope=use_rope
+        )
         self.dim = dim
         self.heads = heads
         self.head_dim = dim // heads
@@ -151,6 +155,17 @@ class RingMultiHeadAttention:
         qkv, _ = d._qkv.apply(params["qkv"], {}, x)
         qkv = qkv.reshape(b, s_local, 3, self.heads, self.head_dim)
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        if self.use_rope:
+            # rope is a pure function of each token's GLOBAL position, so
+            # rotating the local q/k shards before the ring reproduces the
+            # dense rope attention exactly (K blocks travel pre-rotated).
+            from jax import lax
+
+            from tpu_dist import nn
+
+            r = lax.axis_index(self.axis_name)
+            pos = r * s_local + jnp.arange(s_local)
+            q, k = nn.rope(q, pos), nn.rope(k, pos)
         o = ring_attention(q, k, v, self.axis_name, causal=self.causal)
         o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, self.dim)
         y, _ = d._out.apply(params["out"], {}, o)
