@@ -47,3 +47,25 @@ def test_assert_no_aliasing_detects_donated_buffer():
 
 def test_assert_no_aliasing_ok_on_distinct():
     utils.assert_no_aliasing({"a": jnp.ones(3)}, {"b": jnp.zeros(3)})
+
+
+def test_trainer_rejects_buffer_sharing_optimizer():
+    """An optimizer whose init returns params leaves UNCOPIED would get
+    the same device buffer donated through two step arguments (jax maps
+    equal device_put inputs to one buffer); Trainer must refuse loudly
+    at construction instead of desyncing the compiled step."""
+    import pytest
+
+    from tpu_dist import comm, models, train
+
+    mesh = comm.make_mesh(2, ("data",), platform="cpu")
+
+    sharing = train.Optimizer(
+        init=lambda params: {"shadow": params},  # <- no copy
+        update=lambda p, g, s: (p, s),
+    )
+    with pytest.raises(ValueError, match="alias"):
+        train.Trainer(
+            models.mnist_net(), models.IN_SHAPE, mesh,
+            train.TrainConfig(log=lambda s: None), optimizer=sharing,
+        )

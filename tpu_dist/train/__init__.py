@@ -5,9 +5,11 @@ from tpu_dist.train.optim import (
     Optimizer,
     adamw,
     clip_by_global_norm,
+    ema_params,
     from_optax,
     global_norm,
     sgd,
+    with_ema,
 )
 from tpu_dist.train.trainer import EpochStats, TrainConfig, Trainer
 
@@ -18,6 +20,7 @@ __all__ = [
     "Trainer",
     "adamw",
     "clip_by_global_norm",
+    "ema_params",
     "from_optax",
     "global_norm",
     "checkpoint",
@@ -25,4 +28,5 @@ __all__ = [
     "metrics",
     "schedule",
     "sgd",
+    "with_ema",
 ]

@@ -160,3 +160,40 @@ def from_optax(tx) -> Optimizer:
         return optax.apply_updates(params, updates), new_state
 
     return Optimizer(init, update)
+
+
+def with_ema(optimizer: Optimizer, decay: float = 0.999) -> Optimizer:
+    """Track an exponential moving average of the parameters alongside
+    any optimizer: ``ema = decay*ema + (1-decay)*params`` after each
+    update, inside the same compiled step.  The shadow copy lives in the
+    optimizer state under ``"ema"`` (checkpointed with everything else);
+    read it back with `ema_params`.  Evaluating/serving with EMA weights
+    is the standard trick for a final accuracy bump.
+    """
+    if not 0.0 < decay < 1.0:
+        raise ValueError(f"decay must be in (0, 1), got {decay}")
+
+    def init(params):
+        # A REAL copy, not jnp.asarray: the shadow tree must not share
+        # buffers with the live params — under a donating train step a
+        # shared buffer reaches the step through two donated arguments
+        # at once (observed as an XLA:CPU collective-rendezvous crash).
+        return {
+            "base": optimizer.init(params),
+            "ema": jax.tree.map(lambda a: jnp.array(a, copy=True), params),
+        }
+
+    def update(params, grads, state):
+        new_params, base = optimizer.update(params, grads, state["base"])
+        ema = jax.tree.map(
+            lambda e, p: decay * e + (1.0 - decay) * p,
+            state["ema"], new_params,
+        )
+        return new_params, {"base": base, "ema": ema}
+
+    return Optimizer(init, update)
+
+
+def ema_params(opt_state):
+    """The EMA shadow parameters from a `with_ema` optimizer state."""
+    return opt_state["ema"]

@@ -90,6 +90,14 @@ class Trainer:
         self.params = parallel.replicate(params, mesh)
         self.model_state = parallel.replicate(state, mesh)
         self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
+        # The step donates all three trees; any buffer shared between
+        # them (e.g. an optimizer init that returns params leaves
+        # uncopied — device_put maps equal inputs to ONE buffer) would be
+        # donated twice and desync/crash the compiled step.  Fail loudly
+        # here instead (SURVEY.md §5 donation check).
+        from tpu_dist.utils.debug import assert_no_aliasing
+
+        assert_no_aliasing(self.params, self.model_state, self.opt_state)
 
         compute_dtype = (
             jnp.dtype(self.config.compute_dtype)
