@@ -303,3 +303,32 @@ class TestRope:
 
         with pytest.raises(ValueError, match="even head_dim"):
             nn.MultiHeadAttention(6, 2, use_rope=True)  # head_dim 3
+
+
+class TestTopP:
+    def test_top_p_one_is_plain_sampling(self, lm, lm_params):
+        prompt = models.synthetic_tokens(1, 4, 64, seed=2)
+        a = lm.generate(
+            lm_params, prompt, 6, temperature=0.8, key=jax.random.key(3)
+        )
+        b = lm.generate(
+            lm_params, prompt, 6, temperature=0.8, top_p=1.0,
+            key=jax.random.key(3),
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_tiny_top_p_is_greedy(self, lm, lm_params):
+        """A nucleus smaller than the top token's probability keeps only
+        the argmax — sampling degenerates to greedy."""
+        prompt = models.synthetic_tokens(1, 4, 64, seed=2)
+        greedy = lm.generate(lm_params, prompt, 6)
+        nucleus = lm.generate(
+            lm_params, prompt, 6, temperature=1.0, top_p=1e-6,
+            key=jax.random.key(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+
+    def test_invalid_top_p_raises(self, lm, lm_params):
+        prompt = models.synthetic_tokens(1, 4, 64, seed=2)
+        with pytest.raises(ValueError, match="top_p"):
+            lm.generate(lm_params, prompt, 4, temperature=1.0, top_p=0.0)

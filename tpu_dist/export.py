@@ -69,6 +69,7 @@ def export_generate(
     *,
     temperature: float = 0.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     path: str | Path | None = None,
 ) -> bytes:
     """Serialize the LM's KV-cache decode: ``(prompt, key) -> tokens``.
@@ -79,7 +80,7 @@ def export_generate(
     def gen_seeded(prompt, seed):
         return lm.generate(
             params, prompt, steps, key=jax.random.key(seed),
-            temperature=temperature, top_k=top_k,
+            temperature=temperature, top_k=top_k, top_p=top_p,
         )
 
     spec = (

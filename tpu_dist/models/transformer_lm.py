@@ -141,6 +141,7 @@ class TransformerLM(Module):
         key=None,
         temperature: float = 0.0,
         top_k: int | None = None,
+        top_p: float | None = None,
         cache_len: int | None = None,
     ):
         """Sample ``steps`` tokens after ``prompt`` ``(b, s_prompt)``.
@@ -148,10 +149,13 @@ class TransformerLM(Module):
         TPU-native decode: one multi-token prefill, then a ``lax.scan``
         over single-token steps against the static KV cache — the whole
         call is one compiled program (jit-compatible; ``steps``,
-        ``temperature``, ``top_k`` are static).  ``temperature=0`` is
-        greedy argmax; otherwise softmax sampling at the given
-        temperature, optionally truncated to the ``top_k`` highest-logit
-        tokens.  Returns ``(b, steps)`` sampled tokens.
+        ``temperature``, ``top_k``, ``top_p`` are static).
+        ``temperature=0`` is greedy argmax; otherwise softmax sampling at
+        the given temperature, optionally truncated to the ``top_k``
+        highest-logit tokens and/or the nucleus of smallest-probability
+        mass ``top_p`` (both cut the tail; tokens surviving both are
+        renormalized by the categorical draw).  Returns ``(b, steps)``
+        sampled tokens.
         """
         from jax import lax
 
@@ -163,6 +167,8 @@ class TransformerLM(Module):
             )
         if top_k is not None and top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if key is None:
             key = jax.random.key(0)
 
@@ -173,6 +179,18 @@ class TransformerLM(Module):
             if top_k is not None:
                 kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
                 logits = jnp.where(logits < kth, -1e30, logits)
+            if top_p is not None:
+                # nucleus: drop tokens in the tail beyond cumulative
+                # probability top_p (the highest-probability token always
+                # survives: its exclusive-cumsum is 0 < top_p)
+                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True) - 1
+                cutoff = jnp.take_along_axis(
+                    sorted_logits, cutoff_idx, axis=-1
+                )
+                logits = jnp.where(logits < cutoff, -1e30, logits)
             return jax.random.categorical(k, logits).astype(prompt.dtype)
 
         cache = self.init_cache(b, L, dtype=params["embed"]["table"].dtype)
