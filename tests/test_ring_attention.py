@@ -212,3 +212,39 @@ def test_rope_lm_seq_parallel_matches_dense():
     out = np.asarray(run(fn, params, tokens, world=4))  # (ranks, b, s/4, V)
     got = np.concatenate([out[r] for r in range(4)], axis=1)
     np.testing.assert_allclose(got, np.asarray(dense), rtol=1e-4, atol=2e-4)
+
+
+def test_rope_composes_with_ulysses():
+    """Rotating local q/k shards by their GLOBAL positions before the
+    head-resharding all_to_all equals dense rope attention — rope is
+    position-pure, so it commutes with both SP strategies."""
+    import numpy as np
+
+    from tests.conftest import spmd_run as run
+    from tpu_dist import comm, nn, parallel
+
+    b, h, S, d, world = 2, 8, 32, 16, 4
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, S, d))
+    k = jax.random.normal(kk, (b, h, S, d))
+    v = jax.random.normal(kv, (b, h, S, d))
+    pos = jax.numpy.arange(S)
+    dense = nn.dot_product_attention(
+        nn.rope(q, pos), nn.rope(k, pos), v, causal=True
+    )
+
+    def fn(q, k, v):
+        r = comm.rank()
+        s_local = S // world
+        sl = lambda t: jax.lax.dynamic_slice_in_dim(  # noqa: E731
+            t, r * s_local, s_local, 2
+        )
+        lpos = r * s_local + jax.numpy.arange(s_local)
+        ql, kl = nn.rope(sl(q), lpos), nn.rope(sl(k), lpos)
+        return parallel.ulysses_attention(
+            ql, kl, sl(v), comm.DEFAULT_AXIS, causal=True
+        )
+
+    out = np.asarray(run(fn, q, k, v, world=world))  # (world, b, h, s/w, d)
+    got = np.concatenate([out[r] for r in range(world)], axis=2)
+    np.testing.assert_allclose(got, np.asarray(dense), rtol=1e-4, atol=1e-5)
