@@ -61,11 +61,15 @@ def main():
     ms = parallel.replicate({}, mesh)
     os_ = parallel.replicate(opt.init(params), mesh)
 
+    val_windows = None
     if args.corpus:
-        corpus = data.load_text(args.corpus, seq_len=args.seq)
-        windows = np.stack([corpus[i] for i in range(len(corpus))])
+        train_part, val_part = data.load_text(
+            args.corpus, seq_len=args.seq, val_fraction=0.1
+        )
+        windows = np.stack([train_part[i] for i in range(len(train_part))])
+        val_windows = np.stack([val_part[i] for i in range(len(val_part))])
         rng = np.random.default_rng(1234)  # same stream on every host
-        source = f"{args.corpus} ({len(corpus)} windows)"
+        source = f"{args.corpus} ({len(train_part)} train windows)"
 
         def batch_at(i):
             idx = rng.integers(0, len(windows), size=args.batch)
@@ -89,6 +93,13 @@ def main():
     tok_s = args.steps * args.batch * args.seq / dt
     print(f"done: {tok_s:,.0f} tokens/s (expect decreasing loss — "
           f"{'real text' if args.corpus else 'a learnable Markov chain'})")
+    if val_windows is not None:
+        host_params = jax.tree.map(lambda a: np.asarray(a), p)
+        vloss, ppl = models.lm_perplexity(
+            lm, host_params, val_windows, batch=min(64, len(val_windows))
+        )
+        print(f"held-out: loss {vloss:.4f}, perplexity {ppl:.1f} "
+              f"(uniform would be {lm.vocab})")
 
 
 if __name__ == "__main__":

@@ -136,3 +136,25 @@ def test_seq_parallel_matches_dense(lm, lm_params):
     np.testing.assert_allclose(
         gathered, np.asarray(dense), rtol=2e-4, atol=2e-4
     )
+
+
+def test_perplexity_of_untrained_model_is_near_vocab(lm, lm_params):
+    """An untrained model is ~uniform over the vocab, so perplexity sits
+    near |V|; training must push it down."""
+    tokens = models.synthetic_tokens(40, 16, 64)
+    loss0, ppl0 = models.lm_perplexity(lm, lm_params, tokens, batch=16)
+    assert 40 <= ppl0 <= 90, ppl0  # near vocab=64
+
+    params = lm_params
+    step = jax.jit(
+        jax.value_and_grad(
+            lambda p: models.lm_loss(lm.apply(p, {}, tokens)[0], tokens)
+        )
+    )
+    for _ in range(60):
+        _, g = step(params)
+        params = jax.tree.map(lambda a, b: a - 0.3 * b, params, g)
+    loss1, ppl1 = models.lm_perplexity(lm, params, tokens, batch=16)
+    assert ppl1 < ppl0 * 0.5, (ppl0, ppl1)
+    # token-weighted mean == exp link
+    assert abs(np.exp(loss1) - ppl1) < 1e-3

@@ -11,6 +11,7 @@ from tpu_dist.models.transformer_lm import (
     TransformerLM,
     lm_loss,
     lm_loss_seq_parallel,
+    lm_perplexity,
     markov_table,
     synthetic_tokens,
 )
@@ -24,6 +25,7 @@ __all__ = [
     "ViT",
     "lm_loss",
     "lm_loss_seq_parallel",
+    "lm_perplexity",
     "markov_table",
     "mnist_net",
     "resnet18",

@@ -345,3 +345,33 @@ def synthetic_tokens(
     for t in range(1, seq):
         out[:, t] = table[out[:, t - 1]]
     return jnp.asarray(out)
+
+
+def lm_perplexity(lm, params, tokens, *, batch: int = 64):
+    """Token-weighted mean next-token loss and perplexity over a
+    ``(N, S)`` token array (e.g. stacked `data.TextCorpus` windows).
+
+    Batches are processed with at most two compiled shapes (full batches
+    plus one tail batch); each window contributes ``S - 1`` predicted
+    positions.  Returns ``(mean_loss, perplexity)`` — the reference-style
+    scalar observable for the LM family (perplexity = exp(loss))."""
+    import numpy as np
+
+    n, s = tokens.shape
+    if n == 0:
+        raise ValueError("empty token array")
+
+    @jax.jit
+    def batch_loss(p, t):
+        logits, _ = lm.apply(p, {}, t)
+        return lm_loss(logits, t)
+
+    total, weight = 0.0, 0
+    for i in range(0, n, batch):
+        chunk = tokens[i : i + batch]
+        loss = float(batch_loss(params, jnp.asarray(np.asarray(chunk))))
+        w = chunk.shape[0] * (s - 1)
+        total += loss * w
+        weight += w
+    mean = total / weight
+    return mean, float(jnp.exp(mean))
