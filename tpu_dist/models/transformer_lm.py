@@ -227,6 +227,50 @@ class TransformerLM(Module):
         h, _ = self.ln.apply(params["ln"], {}, h)
         return h @ params["embed"]["table"].T
 
+    def apply_pipeline(
+        self, params, tokens, axis_name, *, n_microbatches: int = 4
+    ):
+        """Pipeline-parallel forward for use INSIDE shard_map over a
+        ``pipe`` axis: rank r runs ``depth / n`` consecutive blocks as
+        its stage; activations hop stage-to-stage through the GPipe
+        microbatch schedule (`tpu_dist.parallel.pipeline_apply`).  The
+        embedding trunk and the LN/vocab head are token-local and cheap,
+        so they run replicated on every rank rather than as dedicated
+        stages.  Same replicated params as `apply`; tests assert
+        agreement."""
+        from jax import lax
+
+        from tpu_dist.parallel.pipeline import pipeline_apply
+        from tpu_dist.utils.tree import stack_pytrees
+
+        n = lax.axis_size(axis_name)
+        r = lax.axis_index(axis_name)
+        depth = len(self.blocks)
+        if depth % n:
+            raise ValueError(
+                f"depth {depth} not divisible by pipeline world {n}"
+            )
+        per = depth // n
+        stacked = stack_pytrees(params["blocks"])  # (depth, ...) leaves
+        mine = jax.tree.map(
+            lambda t: lax.dynamic_slice_in_dim(t, r * per, per, 0), stacked
+        )
+        blk = self.blocks[0]  # stages share the block architecture
+
+        def stage_fn(stage_params, h):
+            for i in range(per):
+                pb = jax.tree.map(lambda t: t[i], stage_params)
+                h, _ = blk.apply(pb, {}, h)
+            return h
+
+        h = self._trunk(params, tokens)
+        h = pipeline_apply(
+            stage_fn, mine, h,
+            n_microbatches=n_microbatches, axis_name=axis_name,
+        )
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        return h @ params["embed"]["table"].T
+
     def apply_seq_parallel(self, params, tokens_local, axis_name):
         """Sequence-parallel forward for use INSIDE shard_map: tokens are
         the local sequence shard; attention runs as a ppermute ring over
