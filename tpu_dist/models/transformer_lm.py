@@ -228,7 +228,8 @@ class TransformerLM(Module):
         return h @ params["embed"]["table"].T
 
     def apply_pipeline(
-        self, params, tokens, axis_name, *, n_microbatches: int = 4
+        self, params, tokens, axis_name, *,
+        n_microbatches: int = 4, interleave: int = 1,
     ):
         """Pipeline-parallel forward for use INSIDE shard_map over a
         ``pipe`` axis: rank r runs ``depth / n`` consecutive blocks as
@@ -237,37 +238,68 @@ class TransformerLM(Module):
         embedding trunk and the LN/vocab head are token-local and cheap,
         so they run replicated on every rank rather than as dedicated
         stages.  Same replicated params as `apply`; tests assert
-        agreement."""
+        agreement.
+
+        ``interleave=v > 1`` switches to the interleaved (Megatron
+        1F1B-style) schedule: rank r holds ``v`` chunks of
+        ``depth/(n·v)`` blocks (chunk c = global stage ``c·n + r``),
+        cutting the bubble from ``(n-1)/(M+n-1)`` to
+        ``(n-1)/(M·v+n-1)``; ``n_microbatches`` must then be a multiple
+        of the pipe world."""
         from jax import lax
 
-        from tpu_dist.parallel.pipeline import pipeline_apply
+        from tpu_dist.parallel.pipeline import (
+            pipeline_apply,
+            pipeline_apply_interleaved,
+        )
         from tpu_dist.utils.tree import stack_pytrees
 
         n = lax.axis_size(axis_name)
         r = lax.axis_index(axis_name)
         depth = len(self.blocks)
-        if depth % n:
+        if depth % (n * interleave):
             raise ValueError(
-                f"depth {depth} not divisible by pipeline world {n}"
+                f"depth {depth} not divisible by pipeline world {n} x "
+                f"interleave {interleave}"
             )
-        per = depth // n
         stacked = stack_pytrees(params["blocks"])  # (depth, ...) leaves
-        mine = jax.tree.map(
-            lambda t: lax.dynamic_slice_in_dim(t, r * per, per, 0), stacked
-        )
         blk = self.blocks[0]  # stages share the block architecture
 
-        def stage_fn(stage_params, h):
-            for i in range(per):
+        def run_blocks(stage_params, h, count):
+            for i in range(count):
                 pb = jax.tree.map(lambda t: t[i], stage_params)
                 h, _ = blk.apply(pb, {}, h)
             return h
 
         h = self._trunk(params, tokens)
-        h = pipeline_apply(
-            stage_fn, mine, h,
-            n_microbatches=n_microbatches, axis_name=axis_name,
-        )
+        if interleave == 1:
+            per = depth // n
+            mine = jax.tree.map(
+                lambda t: lax.dynamic_slice_in_dim(t, r * per, per, 0),
+                stacked,
+            )
+            h = pipeline_apply(
+                lambda p, a: run_blocks(p, a, per), mine, h,
+                n_microbatches=n_microbatches, axis_name=axis_name,
+            )
+        else:
+            pc = depth // (n * interleave)
+            chunks = [
+                jax.tree.map(
+                    lambda t: lax.dynamic_slice_in_dim(
+                        t, (c * n + r) * pc, pc, 0
+                    ),
+                    stacked,
+                )
+                for c in range(interleave)
+            ]
+            chunks_local = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *chunks
+            )
+            h = pipeline_apply_interleaved(
+                lambda p, a: run_blocks(p, a, pc), chunks_local, h,
+                n_microbatches=n_microbatches, axis_name=axis_name,
+            )
         h, _ = self.ln.apply(params["ln"], {}, h)
         return h @ params["embed"]["table"].T
 
