@@ -160,3 +160,57 @@ def test_tp_attention_indivisible_heads_raises():
 
     with pytest.raises(ValueError, match="not divisible"):
         run(fn, params, x, world=4)
+
+
+def test_tp_vocab_cross_entropy_matches_dense():
+    """Vocab-parallel CE == dense softmax cross-entropy, no full logits."""
+    b, s, d, V = 2, 6, 16, 64
+    h = jax.random.normal(jax.random.key(0), (b, s, d))
+    table = jax.random.normal(jax.random.key(1), (V, d)) / np.sqrt(d)
+    targets = jax.random.randint(jax.random.key(2), (b, s), 0, V)
+
+    logits = h @ table.T
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    expect = float(
+        -jnp.take_along_axis(logp, targets[..., None], axis=-1).mean()
+    )
+
+    def fn(h, table, targets):
+        return parallel.tp_vocab_cross_entropy(
+            h, table, targets, comm.DEFAULT_AXIS
+        )
+
+    out = np.asarray(run(fn, h, table, targets, world=4))
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_lm_loss_tensor_parallel_matches_dense():
+    from tpu_dist import models
+
+    lm = models.TransformerLM(vocab=64, dim=32, depth=2, heads=4, max_seq=16)
+    params, _ = lm.init(jax.random.key(0))
+    tokens = models.synthetic_tokens(2, 8, 64)
+    logits, _ = lm.apply(params, {}, tokens)
+    expect = float(models.lm_loss(logits, tokens))
+
+    def fn(params, tokens):
+        return lm.loss_tensor_parallel(params, tokens, comm.DEFAULT_AXIS)
+
+    out = np.asarray(run(fn, params, tokens, world=4))
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_tp_vocab_indivisible_raises():
+    h = jnp.ones((1, 2, 8))
+    table = jnp.ones((30, 8))
+    targets = jnp.zeros((1, 2), jnp.int32)
+
+    def fn(h, table, targets):
+        return parallel.tp_vocab_cross_entropy(
+            h, table, targets, comm.DEFAULT_AXIS
+        )
+
+    import pytest
+
+    with pytest.raises(ValueError, match="not divisible"):
+        run(fn, h, table, targets, world=4)

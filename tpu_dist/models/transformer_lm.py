@@ -227,6 +227,30 @@ class TransformerLM(Module):
         h, _ = self.ln.apply(params["ln"], {}, h)
         return h @ params["embed"]["table"].T
 
+    def loss_tensor_parallel(self, params, tokens, axis_name):
+        """Next-token loss with the whole model tensor-parallel INCLUDING
+        the output head: blocks via `tp_encoder_block`, cross-entropy via
+        `parallel.tp_vocab_cross_entropy` — the full `(b, s, vocab)`
+        logits tensor is never materialized on any rank.  Equals
+        `lm_loss(apply(...))` (tested)."""
+        from tpu_dist.parallel.tensor_parallel import (
+            tp_encoder_block,
+            tp_vocab_cross_entropy,
+        )
+
+        if self.pos_embedding != "learned":
+            raise ValueError(
+                "loss_tensor_parallel supports learned positions only "
+                "(tp_attention does not apply rope)"
+            )
+        h = self._trunk(params, tokens)
+        for blk, pb in zip(self.blocks, params["blocks"]):
+            h = tp_encoder_block(blk, pb, h, axis_name)
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        return tp_vocab_cross_entropy(
+            h[:, :-1], params["embed"]["table"], tokens[:, 1:], axis_name
+        )
+
     def apply_pipeline(
         self, params, tokens, axis_name, *,
         n_microbatches: int = 4, interleave: int = 1,

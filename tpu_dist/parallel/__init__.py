@@ -50,6 +50,7 @@ from tpu_dist.parallel.tensor_parallel import (
     tp_encoder_block,
     tp_mlp,
     tp_mlp_block,
+    tp_vocab_cross_entropy,
 )
 from tpu_dist.parallel.ring import (
     ring_all_gather,
@@ -85,6 +86,7 @@ __all__ = [
     "tp_encoder_block",
     "tp_mlp",
     "tp_mlp_block",
+    "tp_vocab_cross_entropy",
     "make_fsdp_train_step",
     "make_stateful_train_step",
     "make_train_step",
