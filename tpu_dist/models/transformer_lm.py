@@ -232,7 +232,13 @@ class TransformerLM(Module):
         the output head: blocks via `tp_encoder_block`, cross-entropy via
         `parallel.tp_vocab_cross_entropy` — the full `(b, s, vocab)`
         logits tensor is never materialized on any rank.  Equals
-        `lm_loss(apply(...))` (tested)."""
+        `lm_loss(apply(...))` (tested).
+
+        Gradient contract (tested): each rank's ``jax.grad`` of this
+        loss is its shard's CONTRIBUTION; ``pmean`` over the model axis
+        recovers the dense gradient exactly — i.e. treat the model axis
+        like a data axis in the gradient average and the training step
+        needs no other change."""
         from tpu_dist.parallel.tensor_parallel import (
             tp_encoder_block,
             tp_vocab_cross_entropy,

@@ -47,6 +47,7 @@ from tpu_dist.parallel.tensor_parallel import (
     row_parallel,
     shard_dim,
     tp_attention,
+    tp_embedding,
     tp_encoder_block,
     tp_mlp,
     tp_mlp_block,
@@ -83,6 +84,7 @@ __all__ = [
     "row_parallel",
     "shard_dim",
     "tp_attention",
+    "tp_embedding",
     "tp_encoder_block",
     "tp_mlp",
     "tp_mlp_block",

@@ -174,7 +174,12 @@ def tp_vocab_cross_entropy(
     Vl = V // n
     table_loc = lax.dynamic_slice_in_dim(table, r * Vl, Vl, 0)
     logits = h @ table_loc.T  # (b, s, Vl) — only the local slice
-    m = lax.pmax(logits.max(axis=-1), axis_name)  # (b, s)
+    # The max shift is numerics only — logsumexp is shift-invariant, so
+    # its gradient contribution cancels analytically; stop_gradient both
+    # reflects that and sidesteps pmax's missing differentiation rule.
+    # (stop_gradient must wrap pmax's INPUT: a symbolically-zero tangent
+    # skips the primitive's missing JVP rule entirely)
+    m = lax.pmax(lax.stop_gradient(logits.max(axis=-1)), axis_name)
     z = lax.psum(
         jnp.sum(jnp.exp(logits - m[..., None]), axis=-1), axis_name
     )
@@ -185,6 +190,29 @@ def tp_vocab_cross_entropy(
     ]
     target_logit = lax.psum(jnp.where(in_range, picked, 0.0), axis_name)
     return jnp.mean(-(target_logit - m - jnp.log(z)))
+
+
+def tp_embedding(
+    tokens: jax.Array,
+    table: jax.Array,
+    axis_name: str = MODEL_AXIS,
+) -> jax.Array:
+    """Vocab-parallel embedding lookup (Megatron input layer): each rank
+    looks up only tokens that fall in its vocabulary slice (out-of-range
+    tokens contribute zeros) and one psum assembles the full embeddings —
+    the gather never touches more than ``V/n`` rows per rank.  Pairs with
+    `tp_vocab_cross_entropy` at the output."""
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    V = table.shape[0]
+    if V % n:
+        raise ValueError(f"vocab {V} not divisible by axis size {n}")
+    Vl = V // n
+    table_loc = lax.dynamic_slice_in_dim(table, r * Vl, Vl, 0)
+    in_range = (tokens >= r * Vl) & (tokens < (r + 1) * Vl)
+    local = jnp.clip(tokens - r * Vl, 0, Vl - 1)
+    emb = table_loc[local] * in_range[..., None]
+    return lax.psum(emb, axis_name)
 
 
 def tp_encoder_block(block, params, x, axis_name: str = MODEL_AXIS):
