@@ -109,8 +109,12 @@ def main():
         q = jax.random.normal(kq, shape, jnp.bfloat16)
         k = jax.random.normal(kk, shape, jnp.bfloat16)
         v = jax.random.normal(kv, shape, jnp.bfloat16)
-        # QK^T + AV: 4 * h * S^2 * d mults-adds
-        flops = 4 * args.heads * S * S * args.dim
+        from tpu_dist.train.flops import attention_flops
+
+        # causal-realizable FLOPs (≈half the dense 4·h·S²·d count)
+        flops = attention_flops(
+            1, args.heads, S, S, args.dim, causal=True
+        )
 
         flash_fn = jax.jit(
             lambda q, k, v: ops.flash_attention(

@@ -244,3 +244,13 @@ class TestTextCorpus:
             l, g = step(params)
             params = jax.tree.map(lambda a, b: a - 0.3 * b, params, g)
         assert float(l) < l0 * 0.8
+
+    def test_val_split_on_tiny_corpus_raises_clearly(self, tmp_path):
+        import pytest
+
+        from tpu_dist import data
+
+        p = tmp_path / "tiny.txt"
+        p.write_text("x" * 40)  # exactly 1 window of 32
+        with pytest.raises(ValueError, match="no training windows"):
+            data.load_text(p, seq_len=32, val_fraction=0.1)

@@ -73,6 +73,13 @@ def load_text(
     idx = list(range(len(corpus)))
     random.Random(seed).shuffle(idx)
     n_val = max(1, int(len(idx) * val_fraction))
+    if n_val >= len(idx):
+        raise ValueError(
+            f"corpus has only {len(idx)} window(s) of seq_len={seq_len}; "
+            f"a val_fraction={val_fraction} split would leave no training "
+            f"windows — use a larger corpus, a shorter seq_len, or "
+            f"val_fraction=0"
+        )
     from tpu_dist.data.partition import Partition
 
     return (

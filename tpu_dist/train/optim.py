@@ -112,13 +112,14 @@ def adamw(
 
 
 def global_norm(tree: Any) -> jax.Array:
-    """L2 norm over every leaf of a pytree (f32 accumulation)."""
-    leaves = jax.tree.leaves(tree)
-    if not leaves:
+    """L2 norm over every leaf of a pytree (f32 accumulation).
+    Canonical implementation lives in `tpu_dist.utils.tree`; re-exported
+    here because it's the clipping companion."""
+    from tpu_dist.utils.tree import global_norm as _gn
+
+    if not jax.tree.leaves(tree):
         return jnp.zeros((), jnp.float32)
-    return jnp.sqrt(
-        sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
-    )
+    return _gn(tree)
 
 
 def clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
