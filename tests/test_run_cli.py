@@ -1,0 +1,112 @@
+"""`python -m tpu_dist.run` — the external (torchrun/mpirun-analog)
+launcher: env contract, output passthrough, fail-stop."""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = Path(__file__).parent.parent
+
+
+def launch(script: Path, *extra, timeout=120):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_dist.run", *extra, str(script)],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
+def test_env_contract_and_world(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent("""
+        import os
+        print("R", os.environ["RANK"], "W", os.environ["WORLD_SIZE"],
+              "P", os.environ["MASTER_PORT"], flush=True)
+    """))
+    proc = launch(script, "--nproc", "3")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if " W 3 " in l]
+    assert len(lines) == 3
+    ranks = sorted(l.split("R ")[1].split()[0] for l in lines)
+    assert ranks == ["0", "1", "2"]
+    assert all("[rank " in l for l in lines)  # tagged passthrough
+    ports = {l.rsplit("P ", 1)[1] for l in lines}
+    assert len(ports) == 1  # every rank got the same rendezvous port
+
+
+def test_rankless_omits_rank(tmp_path):
+    script = tmp_path / "r.py"
+    script.write_text(
+        "import os; print('HASRANK', 'RANK' in os.environ, flush=True)"
+    )
+    proc = launch(script, "--nproc", "2", "--rankless", "--no-tag")
+    assert proc.returncode == 0
+    assert proc.stdout.count("HASRANK False") == 2
+
+
+def test_fail_stop_propagates_exit_code(tmp_path):
+    script = tmp_path / "f.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        if os.environ["RANK"] == "1":
+            sys.exit(7)
+        time.sleep(60)  # would hang without fail-stop
+    """))
+    proc = launch(script, "--nproc", "3", timeout=60)
+    assert proc.returncode == 7, proc.stdout + proc.stderr
+    assert "terminating remaining ranks" in proc.stderr
+
+
+def test_script_args_pass_through(tmp_path):
+    script = tmp_path / "a.py"
+    script.write_text("import sys; print('ARGS', *sys.argv[1:], flush=True)")
+    proc = launch(script, "--nproc", "1", "--no-tag", timeout=60)
+    assert proc.returncode == 0
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "tpu_dist.run", "--nproc", "1", "--no-tag",
+         str(script), "--alpha", "beta"],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert "ARGS --alpha beta" in proc2.stdout
+
+
+def test_end_to_end_distributed_psum_via_cli(tmp_path):
+    """Full stack through the external launcher: env-contract init
+    (comm.init -> jax.distributed), cross-process psum, known answer
+    1+2 = 3 on both ranks — the reference's mpirun path, TPU-style."""
+    script = tmp_path / "psum.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {str(REPO)!r})
+        # one simulated device per process (the pytest parent's 8-device
+        # XLA flag would otherwise leak in and give 16 program instances)
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        import numpy as np
+        from tpu_dist import comm
+
+        cfg = comm.init(platform="cpu")  # env contract from tpu_dist.run
+        import jax, jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()), ("ranks",))
+        f = jax.jit(jax.shard_map(
+            lambda: lax.psum(
+                jnp.float32(jax.process_index() + 1), "ranks"
+            ).reshape(1),
+            mesh=mesh, in_specs=(), out_specs=P("ranks"), check_vma=False,
+        ))
+        out = f()
+        print("PSUM", float(np.asarray(out.addressable_shards[0].data)[0]),
+              flush=True)
+    """))
+    proc = launch(script, "--nproc", "2", "--no-tag", timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("PSUM 3.0") == 2, proc.stdout
