@@ -117,3 +117,79 @@ def test_sequential_threads_state():
     assert not np.allclose(
         np.asarray(new_state[1]["mean"]), np.asarray(state[1]["mean"])
     )
+
+
+class TestAttentionMask:
+    def test_allow_all_mask_is_identity(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_dist import nn
+
+        q = jax.random.normal(jax.random.key(0), (2, 2, 6, 8))
+        k = jax.random.normal(jax.random.key(1), (2, 2, 6, 8))
+        v = jax.random.normal(jax.random.key(2), (2, 2, 6, 8))
+        base = nn.dot_product_attention(q, k, v, causal=True)
+        masked = nn.dot_product_attention(
+            q, k, v, causal=True, mask=jnp.ones((6, 6), bool)
+        )
+        np.testing.assert_allclose(
+            np.asarray(base), np.asarray(masked), atol=1e-6
+        )
+
+    def test_padding_mask_equals_trimmed_computation(self):
+        """Masking out trailing pad keys gives the same outputs on the
+        real positions as running the trimmed sequence."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_dist import nn
+
+        s_real, s_pad = 5, 8
+        q = jax.random.normal(jax.random.key(0), (1, 2, s_pad, 8))
+        k = jax.random.normal(jax.random.key(1), (1, 2, s_pad, 8))
+        v = jax.random.normal(jax.random.key(2), (1, 2, s_pad, 8))
+        keymask = (jnp.arange(s_pad) < s_real)[None, None, None, :]
+        full = nn.dot_product_attention(q, k, v, mask=keymask)
+        trimmed = nn.dot_product_attention(
+            q[..., :s_real, :], k[..., :s_real, :], v[..., :s_real, :]
+        )
+        np.testing.assert_allclose(
+            np.asarray(full[..., :s_real, :]), np.asarray(trimmed),
+            atol=1e-5,
+        )
+
+    def test_fully_masked_row_is_zero_not_nan(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_dist import nn
+
+        q = jax.random.normal(jax.random.key(0), (1, 1, 3, 4))
+        k = jax.random.normal(jax.random.key(1), (1, 1, 3, 4))
+        v = jax.random.normal(jax.random.key(2), (1, 1, 3, 4))
+        out = nn.dot_product_attention(
+            q, k, v, mask=jnp.zeros((3, 3), bool)
+        )
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+
+    def test_lm_padding_mask_matches_trimmed_prefix(self):
+        """LM logits at real positions with a padding mask equal the
+        logits of the trimmed batch (learned positions, causal)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_dist import models
+
+        lm = models.TransformerLM(
+            vocab=64, dim=32, depth=2, heads=4, max_seq=16
+        )
+        params, _ = lm.init(jax.random.key(0))
+        tokens = models.synthetic_tokens(2, 8, 64)
+        padded = jnp.pad(tokens, ((0, 0), (0, 4)))
+        mask = (jnp.arange(12) < 8)[None, :].repeat(2, 0)
+        full, _ = lm.apply(params, {}, padded, attn_mask=mask)
+        trimmed, _ = lm.apply(params, {}, tokens)
+        np.testing.assert_allclose(
+            np.asarray(full[:, :8]), np.asarray(trimmed), atol=1e-5
+        )

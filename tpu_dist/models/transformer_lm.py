@@ -86,12 +86,18 @@ class TransformerLM(Module):
         # rope: positions enter inside attention (q/k rotation), not here
         return h
 
-    def apply(self, params, state, tokens, *, train=False, key=None):
+    def apply(self, params, state, tokens, *, train=False, key=None,
+              attn_mask=None):
         """Dense forward: (batch, seq) int tokens -> (batch, seq, vocab)
-        logits (weight-tied head)."""
+        logits (weight-tied head).
+
+        ``attn_mask``: optional boolean — a key-padding mask ``(b, s)``
+        (True = real token) or a full ``(..., s, s)`` mask; combined
+        with the causal mask in every block (use for padded or packed
+        batches)."""
         h = self._trunk(params, tokens)
         for blk, pb in zip(self.blocks, params["blocks"]):
-            h, _ = blk.apply(pb, {}, h, train=train)
+            h, _ = blk.apply(pb, {}, h, train=train, mask=attn_mask)
         h, _ = self.ln.apply(params["ln"], {}, h)
         logits = h @ params["embed"]["table"].T
         return logits, state

@@ -56,9 +56,9 @@ class EncoderBlock(Module):
         pm, _ = self.mlp.init(ks[3], input_shape)
         return {"ln1": pl1, "attn": pa, "ln2": pl2, "mlp": pm}, {}
 
-    def apply(self, params, state, x, *, train=False, key=None):
+    def apply(self, params, state, x, *, train=False, key=None, mask=None):
         h, _ = self.ln1.apply(params["ln1"], {}, x)
-        h, _ = self.attn.apply(params["attn"], {}, h)
+        h, _ = self.attn.apply(params["attn"], {}, h, mask=mask)
         x = x + h
         h, _ = self.ln2.apply(params["ln2"], {}, x)
         h, _ = self.mlp.apply(params["mlp"], {}, h)

@@ -23,8 +23,16 @@ def dot_product_attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    mask: jax.Array | None = None,
 ) -> jax.Array:
     """Softmax attention. Shapes: (..., heads, seq, head_dim).
+
+    ``mask``: optional boolean array broadcastable to
+    ``(..., heads, sq, sk)`` — True = attend.  Combined (AND) with the
+    causal mask; use it for padding (keys of pad tokens False) or
+    segment/block-diagonal masking of packed sequences.  Rows with no
+    visible key produce zeros (softmax over an empty set is defined as
+    0 here rather than NaN).
 
     ``causal`` with unequal query/key lengths uses BOTTOM-RIGHT (suffix)
     alignment: the queries are taken to be the last ``sq`` positions of
@@ -45,6 +53,7 @@ def dot_product_attention(
             q.shape == k.shape == v.shape  # self-attention lengths only
             and S >= 128
             and S % bq == 0
+            and mask is None  # kernel has no arbitrary-mask path
         )
         if eligible:
             from tpu_dist.ops.flash_attention import flash_attention
@@ -57,11 +66,22 @@ def dot_product_attention(
         # (cross-attention, indivisible block sizes, short sequences)
     scale = q.shape[-1] ** -0.5
     logits = jnp.einsum("...hqd,...hkd->...hqk", q * scale, k)
+    sq, sk = logits.shape[-2], logits.shape[-1]
+    visible = None
     if causal:
-        sq, sk = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
-        logits = jnp.where(mask, logits, -jnp.inf)
+        visible = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+    if mask is not None:
+        m = jnp.broadcast_to(mask, logits.shape)
+        visible = m if visible is None else (visible & m)
+    if visible is not None:
+        # -1e30 (not -inf) so a fully-masked row softmaxes to a uniform
+        # garbage row we then zero explicitly, instead of NaN
+        logits = jnp.where(visible, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1)
+    if visible is not None:
+        weights = jnp.where(
+            jnp.any(visible, axis=-1, keepdims=True), weights, 0.0
+        )
     return jnp.einsum("...hqk,...hkd->...hqd", weights, v)
 
 
@@ -164,14 +184,20 @@ class MultiHeadAttention(Module):
             return t
         return jnp.repeat(t, self.group, axis=1)
 
-    def apply(self, params, state, x, *, train=False, key=None):
+    def apply(self, params, state, x, *, train=False, key=None, mask=None):
+        """``mask``: optional boolean, either a key-padding mask
+        ``(b, s)`` (True = real token; expanded to block attention TO
+        pad keys) or a full ``(..., sq, sk)`` attention mask."""
         b, s, _ = x.shape
         q, k, v = self._project(params, x)
         if self.use_rope:
             pos = jnp.arange(s)
             q, k = rope(q, pos), rope(k, pos)
+        if mask is not None and mask.ndim == 2:
+            mask = mask[:, None, None, :]  # keys masked, all queries
         o = dot_product_attention(
-            q, self._expand_kv(k), self._expand_kv(v), causal=self.causal
+            q, self._expand_kv(k), self._expand_kv(v),
+            causal=self.causal, mask=mask,
         )
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
         y, _ = self._out.apply(params["out"], {}, o)
