@@ -158,3 +158,23 @@ def test_perplexity_of_untrained_model_is_near_vocab(lm, lm_params):
     assert ppl1 < ppl0 * 0.5, (ppl0, ppl1)
     # token-weighted mean == exp link
     assert abs(np.exp(loss1) - ppl1) < 1e-3
+
+
+def test_masked_lm_loss_on_padded_batch_matches_trimmed(lm, lm_params):
+    """attn_mask + loss mask: the padded batch's loss equals the
+    trimmed batch's loss exactly."""
+    import jax.numpy as jnp
+
+    tokens = models.synthetic_tokens(2, 12, 64)
+    logits, _ = lm.apply(lm_params, {}, tokens)
+    expect = float(models.lm_loss(logits, tokens))
+
+    padded = jnp.pad(tokens, ((0, 0), (0, 4)))
+    mask = (jnp.arange(16) < 12)[None, :].repeat(2, 0)
+    plogits, _ = lm.apply(lm_params, {}, padded, attn_mask=mask)
+    got = float(models.lm_loss(plogits, padded, mask=mask))
+    assert abs(got - expect) < 1e-5, (got, expect)
+
+    # unmasked loss on the padded batch would differ (sanity)
+    bad = float(models.lm_loss(plogits, padded))
+    assert abs(bad - expect) > 1e-3

@@ -380,13 +380,25 @@ class TransformerLM(Module):
         return h @ params["embed"]["table"].T
 
 
-def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+def lm_loss(
+    logits: jax.Array, tokens: jax.Array, *, mask: jax.Array | None = None
+) -> jax.Array:
     """Next-token cross-entropy: predict tokens[:, 1:] from positions
-    [:, :-1]."""
-    return nn.cross_entropy(
-        logits[:, :-1].reshape(-1, logits.shape[-1]),
-        tokens[:, 1:].reshape(-1),
-    )
+    [:, :-1].
+
+    ``mask``: optional ``(b, s)`` boolean of REAL (non-pad) tokens; a
+    position's loss counts only when its target token is real, and the
+    mean is over counted positions — pair with ``apply(attn_mask=...)``
+    so padded batches train identically to trimmed ones (tested)."""
+    b, s, V = logits.shape
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(
+        logp, tokens[:, 1:, None], axis=-1
+    )[..., 0]
+    if mask is None:
+        return -picked.mean()
+    w = mask[:, 1:].astype(jnp.float32)
+    return -(picked * w).sum() / jnp.maximum(w.sum(), 1.0)
 
 
 def lm_loss_seq_parallel(
