@@ -1,6 +1,12 @@
 """`tpu_dist.nn` — minimal functional module system + layer library."""
 
-from tpu_dist.nn.attention import MultiHeadAttention, dot_product_attention, rope
+from tpu_dist.nn.attention import (
+    MultiHeadAttention,
+    dot_product_attention,
+    rope,
+    segment_mask,
+    sliding_window_mask,
+)
 from tpu_dist.nn.core import Lambda, Module, Sequential, fanin_uniform
 from tpu_dist.nn.layers import (
     AvgPool2D,
@@ -35,6 +41,8 @@ __all__ = [
     "Module",
     "MultiHeadAttention",
     "rope",
+    "segment_mask",
+    "sliding_window_mask",
     "Sequential",
     "accuracy",
     "cross_entropy",

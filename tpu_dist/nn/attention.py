@@ -255,3 +255,25 @@ class MultiHeadAttention(Module):
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
         y, _ = self._out.apply(params["out"], {}, o)
         return y, k_cache, v_cache
+
+
+def sliding_window_mask(seq: int, window: int) -> jax.Array:
+    """Boolean ``(seq, seq)`` mask where query i sees keys
+    ``i-window+1 .. i`` (AND it with causal via dot_product_attention's
+    ``causal=True``, or use alone for bidirectional local attention:
+    |i-j| < window).  The Mistral-style local-attention pattern."""
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    i = jnp.arange(seq)[:, None]
+    j = jnp.arange(seq)[None, :]
+    return jnp.abs(i - j) < window
+
+
+def segment_mask(segment_ids: jax.Array) -> jax.Array:
+    """Block-diagonal mask for PACKED sequences: ``segment_ids`` is
+    ``(b, s)`` ints labeling which document each token belongs to;
+    returns ``(b, 1, s, s)`` boolean allowing attention only within the
+    same segment.  Combine with ``causal=True`` so packed training
+    matches per-document training (tested)."""
+    same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+    return same
