@@ -12,9 +12,13 @@ from tpu_dist.train.optim import (
     with_ema,
 )
 from tpu_dist.train.trainer import EpochStats, TrainConfig, Trainer
+from tpu_dist.train.lm_trainer import LMEpochStats, LMTrainConfig, LMTrainer
 
 __all__ = [
     "EpochStats",
+    "LMEpochStats",
+    "LMTrainConfig",
+    "LMTrainer",
     "Optimizer",
     "TrainConfig",
     "Trainer",
