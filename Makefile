@@ -7,6 +7,7 @@ PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
 .PHONY: test ptp gather allreduce train bench runtime train-image \
+        kernels decode \
         scaling multiproc longcontext train-lm generate docs demos
 
 test:
@@ -47,6 +48,12 @@ train-lm:
 
 generate:
 	cd demos && $(PY) generate.py --platform $(PLATFORM)
+
+kernels:
+	$(PY) benchmarks/kernels.py --platform $(PLATFORM)
+
+decode:
+	$(PY) benchmarks/decode.py --platform $(PLATFORM)
 
 docs:
 	$(PY) tools/render_docs.py

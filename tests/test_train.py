@@ -362,3 +362,30 @@ def test_ema_in_trainer_checkpoints(tmp_path, mesh, dataset):
         jax.tree.leaves(ema),
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_adamw_decay_mask_spares_biases():
+    """With a decay mask, masked leaves get the pure-adam update (no
+    decay term) while matrices still decay."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_dist import train
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    grads = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}  # isolate decay
+
+    plain = train.adamw(0.1, weight_decay=0.5)
+    masked = train.adamw(
+        0.1, weight_decay=0.5, decay_mask=train.decay_mask_default
+    )
+    p1, _ = plain.update(params, grads, plain.init(params))
+    p2, _ = masked.update(params, grads, masked.init(params))
+    # zero grads: the only update is -lr*wd*p where decay applies
+    np.testing.assert_allclose(np.asarray(p1["w"]), 0.95)
+    np.testing.assert_allclose(np.asarray(p1["b"]), 0.95)
+    np.testing.assert_allclose(np.asarray(p2["w"]), 0.95)
+    np.testing.assert_allclose(np.asarray(p2["b"]), 1.0)  # spared
+
+    assert train.decay_mask_default("['blocks'][0]['ln1']['scale']", jnp.ones((8,))) is False
+    assert train.decay_mask_default("['mlp']['fc1']['w']", jnp.ones((8, 8))) is True

@@ -5,6 +5,7 @@ from tpu_dist.train.optim import (
     Optimizer,
     adamw,
     clip_by_global_norm,
+    decay_mask_default,
     ema_params,
     from_optax,
     global_norm,
@@ -24,6 +25,7 @@ __all__ = [
     "Trainer",
     "adamw",
     "clip_by_global_norm",
+    "decay_mask_default",
     "ema_params",
     "from_optax",
     "global_norm",

@@ -78,9 +78,15 @@ def adamw(
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    decay_mask=None,
 ) -> Optimizer:
     """AdamW.  ``lr`` may be a float or a schedule ``f(step) -> lr``
-    (the state's step counter drives it, matching `sgd`)."""
+    (the state's step counter drives it, matching `sgd`).
+
+    ``decay_mask``: optional ``fn(path_str, leaf) -> bool`` selecting
+    which parameters weight decay applies to (standard practice: skip
+    biases and norm scales).  ``decay_mask_default`` implements that
+    convention; None decays everything (backward compatible)."""
     lr_fn = lr if callable(lr) else (lambda _step: lr)
 
     def init(params):
@@ -100,15 +106,39 @@ def adamw(
         bc1 = 1 - b1**step.astype(jnp.float32)
         bc2 = 1 - b2**step.astype(jnp.float32)
 
-        def upd(p, m_, v_):
+        def upd(p, m_, v_, decay_on=True):
             mh = m_ / bc1
             vh = v_ / bc2
-            return p - cur_lr * (mh / (jnp.sqrt(vh) + eps) + weight_decay * p)
+            wd = weight_decay if decay_on else 0.0
+            return p - cur_lr * (mh / (jnp.sqrt(vh) + eps) + wd * p)
 
-        new_params = jax.tree.map(upd, params, m, v)
+        if decay_mask is None:
+            new_params = jax.tree.map(upd, params, m, v)
+        else:
+            flat_p = jax.tree_util.tree_flatten_with_path(params)
+            paths = [jax.tree_util.keystr(pth) for pth, _ in flat_p[0]]
+            leaves_p = [leaf for _, leaf in flat_p[0]]
+            leaves_m = jax.tree.leaves(m)
+            leaves_v = jax.tree.leaves(v)
+            new_leaves = [
+                upd(p_, m_, v_, decay_mask(path, p_))
+                for path, p_, m_, v_ in zip(
+                    paths, leaves_p, leaves_m, leaves_v
+                )
+            ]
+            new_params = jax.tree_util.tree_unflatten(flat_p[1], new_leaves)
         return new_params, {"step": step, "m": m, "v": v}
 
     return Optimizer(init, update)
+
+
+def decay_mask_default(path: str, leaf) -> bool:
+    """The standard AdamW decay convention: decay matrices, skip biases,
+    norm scales, and any 1-D parameter."""
+    lowered = path.lower()
+    if any(tag in lowered for tag in ("bias", "scale", "'b'", "[b]")):
+        return False
+    return getattr(leaf, "ndim", 0) >= 2
 
 
 def global_norm(tree: Any) -> jax.Array:
