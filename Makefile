@@ -7,7 +7,7 @@ PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
 .PHONY: test ptp gather allreduce train bench runtime train-image \
-        kernels decode \
+        kernels decode serve \
         scaling multiproc longcontext train-lm generate docs demos
 
 test:
@@ -61,3 +61,6 @@ docs:
 # All four reference-parity demos in sequence (the reference's scripts,
 # TPU-style), on the simulated mesh by default.
 demos: ptp gather allreduce train
+
+serve:
+	cd demos && $(PY) serve.py --platform $(PLATFORM)

@@ -1,0 +1,71 @@
+"""Serving demo — train, export, then serve from the artifact alone.
+
+Self-verifying: the tiny LM is trained on the Markov corpus, the FULL
+decode loop (prefill + scanned sampling) is sealed into a StableHLO
+artifact with `tpu_dist.export`, and the artifact is loaded back and
+called — the served continuation must follow the Markov transition
+table exactly like the live model's (both accuracies printed, expect
+>= 0.9 and bit-identical tokens).
+"""
+
+from _common import parse_args
+
+
+def main():
+    args = parse_args(
+        default_world=None,
+        steps=(int, 150, "training steps"),
+        gen=(int, 24, "tokens to generate per stream"),
+    )
+    import functools
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import export, models
+
+    lm = models.TransformerLM(vocab=64, dim=64, depth=2, heads=4, max_seq=96)
+    params, _ = lm.init(jax.random.key(1234))
+    tokens = models.synthetic_tokens(64, 16, 64, seed=0)
+
+    step = jax.jit(
+        jax.value_and_grad(
+            lambda p: models.lm_loss(lm.apply(p, {}, tokens)[0], tokens)
+        )
+    )
+    for i in range(args.steps):
+        loss, g = step(params)
+        params = jax.tree.map(lambda p, g_: p - 0.3 * g_, params, g)
+    print(f"trained: final loss {float(loss):.4f}")
+
+    prompt = tokens[:8, :2]
+    live = np.asarray(lm.generate(params, prompt, args.gen))
+
+    with tempfile.TemporaryDirectory() as d:
+        path = Path(d) / "lm_decode.stablehlo"
+        blob = export.export_generate(
+            lm, params, tuple(prompt.shape), args.gen, path=path
+        )
+        print(f"exported decode artifact: {len(blob):,} bytes")
+        served_fn = export.load(path)
+        served = np.asarray(served_fn(prompt, jnp.uint32(0)))
+
+    table = models.markov_table(64, seed=0)
+    cur = np.asarray(prompt[:, -1])
+    want = np.empty_like(served)
+    for t in range(args.gen):
+        cur = table[cur]
+        want[:, t] = cur
+    print(f"live accuracy vs chain:   {(live == want).mean():.2f}")
+    print(f"served accuracy vs chain: {(served == want).mean():.2f} "
+          f"(expect >= 0.9)")
+    print(f"served == live tokens: {bool((served == live).all())} "
+          f"(expect True)")
+
+
+if __name__ == "__main__":
+    main()

@@ -75,3 +75,16 @@ def test_train_lm_on_real_text_corpus():
         for l in out.splitlines() if l.lstrip().startswith("step")
     ]
     assert len(losses) > 2 and losses[-1] < losses[0], out
+
+
+def test_serve_demo_served_equals_live():
+    out = run_demo(
+        "serve.py", "--platform", "cpu", "--steps", "120", "--gen", "12",
+        timeout=400,
+    )
+    assert "served == live tokens: True" in out
+    acc = float(
+        [l for l in out.splitlines() if "served accuracy" in l][0]
+        .split(":")[1].split("(")[0]
+    )
+    assert acc >= 0.9, out
