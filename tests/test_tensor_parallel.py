@@ -258,3 +258,26 @@ def test_tp_lm_loss_gradients_average_to_dense():
         np.testing.assert_allclose(
             np.asarray(e), mean, rtol=2e-4, atol=2e-5
         )
+
+
+def test_tp_attention_gqa_matches_dense():
+    """GQA param tree: query heads sharded, kv replicated per rank —
+    same single-psum structure, equal to the dense GQA module."""
+    from tpu_dist import nn
+
+    dim, heads, kvh = 32, 4, 2
+    mha = nn.MultiHeadAttention(dim, heads, causal=True, kv_heads=kvh)
+    params, _ = mha.init(jax.random.key(8), (6, dim))
+    x = jax.random.normal(jax.random.key(9), (2, 6, dim))
+    expect, _ = mha.apply(params, {}, x)
+
+    def fn(params, x):
+        return parallel.tp_attention(
+            x, params, heads, comm.DEFAULT_AXIS, causal=True
+        )
+
+    out = np.asarray(run(fn, params, x, world=4))
+    for r in range(4):
+        np.testing.assert_allclose(
+            out[r], np.asarray(expect), rtol=1e-4, atol=1e-5
+        )

@@ -106,38 +106,61 @@ def tp_attention(
     ``heads / axis_size`` complete heads locally and the row-parallel
     output projection finishes with ONE psum.
 
-    ``attn_params`` is `nn.MultiHeadAttention`'s replicated pytree
-    (``{"qkv": {"w","b"}, "out": {"w","b"}}``).  The QKV projection is
-    column-parallel per head: the flat ``(dim, 3*dim)`` kernel's output
-    layout is ``(3, heads, head_dim)`` (attention.py reshape), so the
-    per-rank shard slices the HEAD axis of the reshaped kernel — a head
-    never straddles ranks, which is what keeps softmax communication-free.
+    ``attn_params`` is `nn.MultiHeadAttention`'s replicated pytree —
+    either the fused layout (``{"qkv", "out"}``) or the GQA layout
+    (``{"q", "kv", "out"}``).  The Q projection is column-parallel per
+    head: the kernel's output layout is ``(3, heads, head_dim)`` /
+    ``(heads, head_dim)`` (attention.py reshape), so the per-rank shard
+    slices the HEAD axis of the reshaped kernel — a head never straddles
+    ranks, which is what keeps softmax communication-free.  Under GQA the
+    (small) K/V projection runs replicated on every rank and each local
+    query head selects its group's kv head — same single psum.
     """
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     if heads % n:
         raise ValueError(f"heads {heads} not divisible by axis size {n}")
-    if "qkv" not in attn_params:
-        raise ValueError(
-            "tp_attention requires the fused-QKV layout (kv_heads == "
-            "heads); GQA param trees are not supported here yet"
-        )
     hl = heads // n
-    w = attn_params["qkv"]["w"]
-    d = w.shape[0]
-    hd = w.shape[1] // (3 * heads)
-    w_loc = lax.dynamic_slice_in_dim(
-        w.reshape(d, 3, heads, hd), r * hl, hl, 2
-    ).reshape(d, 3 * hl * hd)
-    b_loc = lax.dynamic_slice_in_dim(
-        attn_params["qkv"]["b"].reshape(3, heads, hd), r * hl, hl, 1
-    ).reshape(3 * hl * hd)
+    bsz, s, _ = x.shape
 
     from tpu_dist.nn.attention import dot_product_attention
 
-    bsz, s, _ = x.shape
-    qkv = (x @ w_loc + b_loc).reshape(bsz, s, 3, hl, hd)
-    q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    if "qkv" in attn_params:
+        w = attn_params["qkv"]["w"]
+        d = w.shape[0]
+        hd = w.shape[1] // (3 * heads)
+        w_loc = lax.dynamic_slice_in_dim(
+            w.reshape(d, 3, heads, hd), r * hl, hl, 2
+        ).reshape(d, 3 * hl * hd)
+        b_loc = lax.dynamic_slice_in_dim(
+            attn_params["qkv"]["b"].reshape(3, heads, hd), r * hl, hl, 1
+        ).reshape(3 * hl * hd)
+        qkv = (x @ w_loc + b_loc).reshape(bsz, s, 3, hl, hd)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    else:  # GQA tree {"q", "kv", "out"}
+        wq = attn_params["q"]["w"]
+        d = wq.shape[0]
+        hd = wq.shape[1] // heads
+        kv_heads = attn_params["kv"]["w"].shape[1] // (2 * hd)
+        group = heads // kv_heads
+        wq_loc = lax.dynamic_slice_in_dim(
+            wq.reshape(d, heads, hd), r * hl, hl, 1
+        ).reshape(d, hl * hd)
+        bq_loc = lax.dynamic_slice_in_dim(
+            attn_params["q"]["b"].reshape(heads, hd), r * hl, hl, 0
+        ).reshape(hl * hd)
+        q = jnp.moveaxis(
+            (x @ wq_loc + bq_loc).reshape(bsz, s, hl, hd), 1, 2
+        )
+        kv = (x @ attn_params["kv"]["w"] + attn_params["kv"]["b"]).reshape(
+            bsz, s, 2, kv_heads, hd
+        )
+        k_full, v_full = (jnp.moveaxis(kv[:, :, i], 1, 2) for i in range(2))
+        # local query head i (global r*hl + i) reads kv head (global)//group
+        kv_idx = (r * hl + jnp.arange(hl)) // group
+        k = jnp.take(k_full, kv_idx, axis=1)
+        v = jnp.take(v_full, kv_idx, axis=1)
+
     o = dot_product_attention(q, k, v, causal=causal)  # (b, hl, s, hd)
     o = jnp.moveaxis(o, 1, 2).reshape(bsz, s, hl * hd)
 
