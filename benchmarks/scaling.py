@@ -34,6 +34,12 @@ def _build_model(name: str):
         return models.vit_tiny(image_size=224, patch=16, num_classes=1000), (
             224, 224, 3,
         )
+    if name == "lm":
+        # byte-vocab TransformerLM: the long-context family's DP
+        # scaling number (tokens/s = samples/s x seq)
+        return models.TransformerLM(
+            vocab=256, dim=256, depth=4, heads=8, max_seq=512
+        ), (512,)
     raise SystemExit(f"unknown --model {name!r}")
 
 
@@ -58,23 +64,34 @@ def measure(
     # closure resolves at trace time in this scope
     loss_metric = nn.nll_loss if model_name == "mnist" else nn.cross_entropy
 
-    def loss_fn(p, s, batch, key):
-        x, y = batch
-        scores, s2 = model.apply(p, s, x, train=True, key=key)
-        return loss_metric(scores, y), (s2, {})
+    if model_name == "lm":
+        def loss_fn(p, s, batch, key):
+            (tokens,) = batch
+            logits, _ = model.apply(p, s, tokens, train=True, key=key)
+            return models.lm_loss(logits, tokens), ({}, {})
+    else:
+        def loss_fn(p, s, batch, key):
+            x, y = batch
+            scores, s2 = model.apply(p, s, x, train=True, key=key)
+            return loss_metric(scores, y), (s2, {})
 
     step = parallel.make_stateful_train_step(loss_fn, opt, mesh)
     p = parallel.replicate(params, mesh)
     ms = parallel.replicate(state, mesh)
     os_ = parallel.replicate(opt.init(params), mesh)
     global_batch = batch_per_chip * world
-    batch = parallel.shard_batch(
-        (
-            jnp.zeros((global_batch,) + in_shape, jnp.float32),
-            jnp.zeros((global_batch,), jnp.int32),
-        ),
-        mesh,
-    )
+    if model_name == "lm":
+        batch = parallel.shard_batch(
+            (jnp.zeros((global_batch,) + in_shape, jnp.int32),), mesh
+        )
+    else:
+        batch = parallel.shard_batch(
+            (
+                jnp.zeros((global_batch,) + in_shape, jnp.float32),
+                jnp.zeros((global_batch,), jnp.int32),
+            ),
+            mesh,
+        )
     key = jax.random.key(1)
     for _ in range(3):
         p, ms, os_, loss, _ = step(p, ms, os_, batch, key)

@@ -141,3 +141,49 @@ def test_fsdp_gather_roundtrip(cpu_devices):
     back = parallel.fsdp_gather_params(sh, params)
     for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lm_trains_under_fsdp():
+    """The TransformerLM through the ZeRO-3 step: loss decreases and the
+    trajectory matches replicated DP to fp tolerance."""
+    import numpy as np
+
+    from tpu_dist import comm, models, parallel, train
+
+    mesh = comm.make_mesh(4, ("data",), platform="cpu")
+    lm = models.TransformerLM(vocab=64, dim=32, depth=1, heads=4, max_seq=16)
+    params, _ = lm.init(jax.random.key(0))
+    tokens = models.synthetic_tokens(16, 16, 64)
+    opt = train.adamw(3e-3)
+
+    def loss_fn(p, batch, key):
+        (t,) = batch
+        logits, _ = lm.apply(p, {}, t)
+        return models.lm_loss(logits, t), {}
+
+    step, sp, so = parallel.make_fsdp_train_step(
+        loss_fn, opt, mesh, params, donate=False
+    )
+    batch = parallel.shard_batch((tokens,), mesh)
+    losses = []
+    for i in range(6):
+        sp, so, loss, _ = step(sp, so, batch, jax.random.key(i))
+        losses.append(float(loss))
+
+    # replicated-DP reference trajectory
+    def loss2(p, s, batch, key):
+        (t,) = batch
+        logits, _ = lm.apply(p, {}, t)
+        return models.lm_loss(logits, t), (s, {})
+
+    dstep = parallel.make_stateful_train_step(loss2, opt, mesh, donate=False)
+    p = parallel.replicate(params, mesh)
+    ms = parallel.replicate({}, mesh)
+    os_ = parallel.replicate(opt.init(params), mesh)
+    ref = []
+    for i in range(6):
+        p, ms, os_, loss, _ = dstep(p, ms, os_, batch, jax.random.key(i))
+        ref.append(float(loss))
+
+    np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+    assert losses[-1] < losses[0]
