@@ -67,8 +67,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int, causal: bool)
         hi = nblocks
     m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    # log-sum-exp per query row (saved for the backward pass)
-    lse_ref[0] = m + jnp.log(l)
+    # log-sum-exp per query row (saved for the backward pass).  lse is
+    # carried as (bh, S, 1) — the trailing singleton makes every block
+    # (1, bq, 1), satisfying the TPU rule that a block's last two dims
+    # divide (8, 128) or equal the array's ((1, bq) blocks on a (bh, S)
+    # array violate it whenever bh > 1 and refuse to lower).
+    lse_ref[0] = (m + jnp.log(l))[:, None]
 
 
 def _flash_forward(q3, k3, v3, causal, bq, bk, interpret):
@@ -84,11 +88,11 @@ def _flash_forward(q3, k3, v3, causal, bq, bk, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, S, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, S), jnp.float32),
+            jax.ShapeDtypeStruct((bh, S, 1), jnp.float32),
         ],
         compiler_params=None
         if interpret
@@ -127,8 +131,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * bq, bq)]
-        dd = d_ref[0, pl.ds(qi * bq, bq)]
+        lse = lse_ref[0, pl.ds(qi * bq, bq), 0]
+        dd = d_ref[0, pl.ds(qi * bq, bq), 0]
         logits = jnp.dot(q * scale, ks.T, preferred_element_type=jnp.float32)
         if causal:
             q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk_), 0)
@@ -162,8 +166,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
     scans key blocks accumulating dQ in f32."""
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    dd = d_ref[0]
+    lse = lse_ref[0, :, 0]  # (bh, S, 1) carry, see _flash_kernel
+    dd = d_ref[0, :, 0]
     bq_, d = q.shape
     S = k_ref.shape[1]
     scale = d**-0.5
@@ -201,11 +205,11 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
     bh, S, d = q3.shape
     go = g.astype(q3.dtype)
     D = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )  # (bh, S) f32
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # (bh, S, 1) f32 — same trailing-singleton carry as lse
 
     full = pl.BlockSpec((1, S, d), lambda b, i: (b, 0, 0))
-    row_full = pl.BlockSpec((1, S), lambda b, i: (b, 0))
+    row_full = pl.BlockSpec((1, S, 1), lambda b, i: (b, 0, 0))
     params = (
         None
         if interpret
@@ -234,8 +238,8 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
         in_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
                   full, full,
                   pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-                  pl.BlockSpec((1, bq), lambda b, i: (b, i))],
+                  pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0))],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, S, d), q3.dtype),
         compiler_params=params,

@@ -47,6 +47,9 @@ def _matmul_kernel(x_ref, w_ref, b_ref, o_ref, acc_ref, *, epilogue: str, nk: in
 
     @pl.when(k == nk - 1)
     def _finish():
+        # b_ref is (1, bn): 1-D operands get Mosaic/XLA layout-mismatched
+        # tilings on real TPU (bf16[n] refuses to compile) — rank-2 rows
+        # are the native layout, and broadcasting handles the rest.
         out = acc_ref[:] + b_ref[:].astype(jnp.float32)
         o_ref[:] = _EPILOGUES[epilogue](out).astype(o_ref.dtype)
 
@@ -70,7 +73,7 @@ def _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret):
         in_specs=[
             pl.BlockSpec((bm_, bk_), lambda i, j, kk: (i, kk)),
             pl.BlockSpec((bk_, bn_), lambda i, j, kk: (kk, j)),
-            pl.BlockSpec((bn_,), lambda i, j, kk: (j,)),
+            pl.BlockSpec((1, bn_), lambda i, j, kk: (0, j)),
         ],
         out_specs=pl.BlockSpec((bm_, bn_), lambda i, j, kk: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
@@ -108,7 +111,7 @@ def _matmul_bwd(epilogue, bm, bn, bk, interpret, res, g):
         (d_pre,) = act_vjp(g)
     dx = d_pre @ w.T
     dw = x.T @ d_pre
-    db = d_pre.sum(0)
+    db = d_pre.sum(0, keepdims=True)  # b is (1, N) inside the core
     return dx.astype(x.dtype), dw.astype(w.dtype), db.astype(b.dtype)
 
 
@@ -143,7 +146,8 @@ def matmul(
         raise ValueError(f"inner dims mismatch: {x.shape} @ {w.shape}")
     if b is None:
         b = jnp.zeros((n,), x.dtype)
-    return _matmul_core(x, w, b, epilogue, bm, bn, bk, interpret)
+    # (1, N) internally — see _matmul_kernel's layout note.
+    return _matmul_core(x, w, b.reshape(1, n), epilogue, bm, bn, bk, interpret)
 
 
 def use_pallas_dense() -> bool:

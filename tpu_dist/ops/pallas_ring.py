@@ -62,7 +62,7 @@ def _ring_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem, *, axis_name):
             dst_ref=comm_buf.at[recv_slot],
             send_sem=send_sem.at[send_slot],
             recv_sem=recv_sem.at[recv_slot],
-            device_id=(right,),
+            device_id=right,  # LOGICAL ids are scalars (tuples are MESH coords)
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
         rdma.start()
