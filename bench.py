@@ -77,16 +77,19 @@ def bench_tpu_dist() -> tuple[float, dict]:
     import jax.random as jrandom
 
     key = jrandom.key(0)
+    from tpu_dist.utils.platform import host_sync
+
     p, ms, os_ = trainer.params, trainer.model_state, trainer.opt_state
     for i in range(WARMUP):
         p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
-    jax.block_until_ready(loss)
-    log(f"warmup done, loss={float(loss):.4f}")
+    # host readback seals the warmup boundary (block_until_ready has been
+    # observed returning early through the tunnel — see host_sync doc)
+    log(f"warmup done, loss={host_sync(loss):.4f}")
 
     t0 = time.perf_counter()
     for i in range(TIMED_STEPS):
         p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
-    jax.block_until_ready(loss)
+    host_sync(loss)  # scalar readback: true completion, see host_sync doc
     dt = time.perf_counter() - t0
     sps = TIMED_STEPS * BATCH / dt
     log(f"tpu_dist: {TIMED_STEPS} steps in {dt:.3f}s -> {sps:,.0f} samples/s/chip")
@@ -107,6 +110,11 @@ def bench_tpu_dist() -> tuple[float, dict]:
         f"step flops={step_flops:.3e}, achieved {achieved / 1e12:.4f} TFLOP/s"
         + (f", MFU {util:.2%}" if util is not None else " (no peak for this platform)")
     )
+    if util is not None and util > 1.0:
+        log(
+            "WARNING: MFU > 100% is physically impossible — the timing or "
+            "FLOPs accounting is broken; do not trust this number"
+        )
     extras = {
         "tflops": round(achieved / 1e12, 4),
         "mfu": round(util, 4) if util is not None else None,

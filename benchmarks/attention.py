@@ -14,21 +14,11 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench_one(fn, *args, iters=5):
-    import jax
-
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+from tpu_dist.utils.timing import bench_chain  # chained in-program timing
 
 
 def main():
@@ -78,17 +68,17 @@ def main():
                     check_vma=False,
                 )
             )
-            return lambda: mapped(q, q, q)
+            return lambda y: mapped(y, y, y)
 
-        full = jax.jit(lambda a: dot_product_attention(a, a, a, causal=args.causal))
         row = {}
-        for name, thunk in [
-            ("full", lambda: full(q)),
+        for name, step in [
+            ("full", lambda y: dot_product_attention(y, y, y, causal=args.causal)),
             ("ring", sharded("ring")),
             ("ulysses", sharded("ulysses")),
         ]:
             try:
-                row[name] = bench_one(thunk) * 1e3
+                # self-attention is shape-preserving: chain out -> q
+                row[name] = bench_chain(step, q, iters=5) * 1e3
             except Exception as e:  # OOM for full at long S is expected
                 row[name] = None
                 print(f"S={S} {name}: {type(e).__name__}", file=sys.stderr)

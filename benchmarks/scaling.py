@@ -92,14 +92,16 @@ def measure(
             ),
             mesh,
         )
+    from tpu_dist.utils.platform import host_sync
+
     key = jax.random.key(1)
     for _ in range(3):
         p, ms, os_, loss, _ = step(p, ms, os_, batch, key)
-    jax.block_until_ready(loss)
+    host_sync(loss)  # scalar readback: true completion, see host_sync doc
     t0 = time.perf_counter()
     for _ in range(steps):
         p, ms, os_, loss, _ = step(p, ms, os_, batch, key)
-    jax.block_until_ready(loss)
+    host_sync(loss)
     dt = time.perf_counter() - t0
     sps = steps * global_batch / dt
 
@@ -113,6 +115,13 @@ def measure(
     tflops = (
         per_dev_flops * world / (dt / steps) / 1e12 if per_dev_flops else None
     )
+    if util is not None and util > 1.0:
+        print(
+            f"WARNING: {model_name} world={world} MFU {util:.2f} > 1 is "
+            "physically impossible — timing or FLOPs accounting is broken; "
+            "do not trust this row",
+            file=sys.stderr,
+        )
     return sps, tflops, util
 
 

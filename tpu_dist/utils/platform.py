@@ -130,3 +130,25 @@ def pin_cpu(n_devices: int | None = None, *, opt_out_env: str | None = None) -> 
         stacklevel=2,
     )
     return False
+
+
+def host_sync(x) -> float:
+    """Force TRUE completion of the device work producing ``x`` and
+    return one element of it as a Python float.
+
+    ``block_until_ready`` is only as honest as the runtime's readiness
+    signal — through a remote/tunneled device it has been observed to
+    return while device work is still in flight, producing benchmark
+    rates above the chip's physical peak.  A host readback of a value
+    that DEPENDS on the result cannot lie: the bytes must exist on the
+    host.  Use this to close every timed region.
+    """
+    import jax
+    import numpy as np
+
+    leaf = jax.tree.leaves(x)[0]
+    try:
+        ndim = leaf.ndim
+    except AttributeError:
+        return float(leaf)
+    return float(np.asarray(leaf[(0,) * ndim]))
