@@ -82,25 +82,47 @@ class AsyncCheckpointer:
         self._thread = None
         self._exc = None
 
-    def save(self, path: str | Path, tree: Any, *, step: int = 0) -> None:
+    def _submit(self, write_fn) -> None:
+        """Join any in-flight write, then run ``write_fn`` (pure file IO —
+        all device→host snapshotting must happen in the caller, BEFORE
+        this, so buffers may be donated immediately after submission)."""
         import threading
 
         self.wait()
-        if jax.process_index() != 0:
-            return
-        # Device→host transfer happens NOW (so the caller may freely
-        # donate/mutate device buffers); everything after runs off-thread.
-        host_tree = jax.tree.map(np.asarray, jax.device_get(tree))
         self._exc = None
 
         def _write():
             try:
-                save(path, host_tree, step=step)
+                write_fn()
             except BaseException as e:  # surfaced on wait()
                 self._exc = e
 
         self._thread = threading.Thread(target=_write, daemon=True)
         self._thread.start()
+
+    def save(self, path: str | Path, tree: Any, *, step: int = 0) -> None:
+        self.wait()
+        if jax.process_index() != 0:
+            return
+        # Device→host transfer happens NOW; everything after is file IO.
+        host_tree = jax.tree.map(np.asarray, jax.device_get(tree))
+        self._submit(lambda: save(path, host_tree, step=step))
+
+    def save_sharded(self, path: str | Path, tree: Any, *, step: int = 0) -> None:
+        """Async `save_sharded`: the device→host shard snapshot happens
+        now (so buffers may be donated immediately after); file IO runs
+        on the background thread.  Unlike `save`, EVERY process writes
+        (its own shards) — the single-writer gate does not apply."""
+        self.wait()
+        p = Path(path)
+        meta_leaves, blobs = _plan_sharded_save(tree)
+        meta = {"step": step, "leaves": meta_leaves}
+
+        def _write():
+            p.mkdir(parents=True, exist_ok=True)
+            _write_sharded(p, meta, blobs)
+
+        self._submit(_write)
 
     def wait(self) -> None:
         """Join the in-flight write (if any); re-raise its error here."""
@@ -118,6 +140,250 @@ class AsyncCheckpointer:
     def __exit__(self, *exc_info):
         self.wait()
         return False
+
+
+# --- sharded checkpointing --------------------------------------------------
+#
+# The single-writer `save` above materializes every leaf on one host —
+# right for replicated DP state (SURVEY.md §5: identical replicas), wrong
+# for FSDP/TP state, where no host holds (or can hold) the global array.
+# `save_sharded` writes each *device shard* as its own file, written by
+# the process that owns the shard's primary replica, and `restore_sharded`
+# rebuilds arrays under ANY target sharding via
+# ``jax.make_array_from_callback`` — so a checkpoint saved FSDP-8 can be
+# restored FSDP-4, tensor-parallel, or fully replicated, and each process
+# reads only the bytes its devices need.
+
+
+def _norm_index(index: tuple, shape: tuple[int, ...]) -> tuple[tuple[int, int], ...]:
+    """Normalize a shard index (tuple of slices, possibly fewer than ndim
+    and with None bounds) to per-dim (start, stop) over ``shape``."""
+    out = []
+    for d, dim in enumerate(shape):
+        sl = index[d] if d < len(index) else slice(None)
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _shard_filename(starts: tuple[int, ...]) -> str:
+    return "shard_" + "_".join(str(s) for s in starts) + ".npz" if starts else "shard_.npz"
+
+
+def _leaf_shard_table(leaf: Any) -> list[dict]:
+    """Global shard table for one leaf: every (offset, shape, file) in the
+    leaf's sharding — known on EVERY process (shardings are global even
+    when the data is not), so process 0 can record the full table."""
+    shape = tuple(leaf.shape)
+    table, seen = [], set()
+    for _dev, index in leaf.sharding.devices_indices_map(shape).items():
+        bounds = _norm_index(index, shape)
+        starts = tuple(b[0] for b in bounds)
+        if starts in seen:  # replicas map to the same file
+            continue
+        seen.add(starts)
+        table.append(
+            {
+                "offset": list(starts),
+                "shape": [b[1] - b[0] for b in bounds],
+                "file": _shard_filename(starts),
+            }
+        )
+    return table
+
+
+def _plan_sharded_save(tree: Any) -> tuple[list[dict], list[tuple[str, tuple, bytes]]]:
+    """Split a sharded save into (meta, blobs-this-process-writes).
+
+    The snapshot to host bytes happens HERE (synchronously), so callers
+    may donate/mutate device buffers afterwards; blob writing is pure IO.
+    """
+    import jax
+
+    leaves, _ = _flatten_with_paths(tree)
+    meta_leaves, blobs = [], []
+    for i, (keypath, leaf) in enumerate(leaves):
+        if not isinstance(leaf, jax.Array):
+            # host-side leaf (numpy/python scalar): replicated by
+            # construction; process 0 writes it as a single full shard.
+            arr = np.asarray(leaf)
+            table = [
+                {
+                    "offset": [0] * arr.ndim,
+                    "shape": list(arr.shape),
+                    "file": _shard_filename((0,) * arr.ndim),
+                }
+            ]
+            meta_leaves.append(
+                {
+                    "path": keypath,
+                    "shape": list(arr.shape),
+                    "dtype": arr.dtype.name,
+                    "shards": table,
+                }
+            )
+            if jax.process_index() == 0:
+                blobs.append((f"leaf_{i}/{table[0]['file']}", arr.shape, arr.tobytes()))
+            continue
+        meta_leaves.append(
+            {
+                "path": keypath,
+                "shape": list(leaf.shape),
+                "dtype": np.dtype(leaf.dtype).name,
+                "shards": _leaf_shard_table(leaf),
+            }
+        )
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:  # exactly one owner per shard
+                continue
+            starts = tuple(
+                b[0] for b in _norm_index(shard.index, tuple(leaf.shape))
+            )
+            data = np.ascontiguousarray(np.asarray(shard.data))
+            blobs.append(
+                (f"leaf_{i}/{_shard_filename(starts)}", data.shape, data.tobytes())
+            )
+    return meta_leaves, blobs
+
+
+def _write_sharded(path: Path, meta: dict, blobs: list[tuple[str, tuple, bytes]]) -> None:
+    import jax
+
+    for rel, shape, raw in blobs:
+        f = path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        tmp = f.with_name(f.name + ".tmp")
+        # flat uint8 + explicit shape: np.save round-trips extension
+        # dtypes (bfloat16, fp8) as raw void, losing the dtype — bytes +
+        # meta dtype is lossless for every dtype.  Write via a handle:
+        # np.savez appends ".npz" to bare paths, breaking the tmp-rename.
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh, data=np.frombuffer(raw, np.uint8), shape=np.asarray(shape, np.int64)
+            )
+        tmp.rename(f)
+    if jax.process_index() == 0:
+        tmp = path / "meta.json.tmp"
+        tmp.write_text(json.dumps(meta))
+        tmp.rename(path / "meta.json")
+
+
+def save_sharded(path: str | Path, tree: Any, *, step: int = 0) -> None:
+    """Checkpoint a pytree of (possibly sharded) ``jax.Array``s without
+    ever materializing a global array on any host.
+
+    Layout: ``path/meta.json`` (structure, shapes, dtypes, full shard
+    table — written by process 0) + ``path/leaf_<i>/shard_<offsets>.npz``
+    (one file per unique shard, written by the process holding the
+    shard's primary replica; replicated leaves produce exactly one file).
+
+    Multi-host: every process must call this (each writes its own
+    shards to the shared filesystem — the ``file://`` rendezvous
+    assumption, tuto.md:430-437); synchronize before reading the
+    checkpoint back (e.g. the next collective, or a barrier)."""
+    path = Path(path)
+    path.mkdir(parents=True, exist_ok=True)
+    meta_leaves, blobs = _plan_sharded_save(tree)
+    _write_sharded(path, {"step": step, "leaves": meta_leaves}, blobs)
+
+
+def _read_region(
+    leaf_dir: Path,
+    meta_leaf: dict,
+    bounds: tuple[tuple[int, int], ...],
+    dtype: np.dtype,
+) -> np.ndarray:
+    """Assemble the half-open region ``bounds`` of one leaf from whichever
+    saved shard files intersect it (the resharding core: target shards
+    need not align with saved shards)."""
+    out = np.empty(tuple(b[1] - b[0] for b in bounds), dtype)
+    covered = 0
+    for rec in meta_leaf["shards"]:
+        src = tuple(
+            (o, o + s) for o, s in zip(rec["offset"], rec["shape"], strict=True)
+        )
+        inter = tuple(
+            (max(a0, b0), min(a1, b1)) for (a0, a1), (b0, b1) in zip(src, bounds)
+        )
+        if any(lo >= hi for lo, hi in inter):
+            continue
+        with np.load(leaf_dir / rec["file"]) as z:
+            block = (
+                z["data"].view(dtype).reshape(tuple(int(s) for s in z["shape"]))
+            )
+        src_sel = tuple(
+            slice(lo - s0, hi - s0) for (lo, hi), (s0, _) in zip(inter, src)
+        )
+        dst_sel = tuple(
+            slice(lo - b0, hi - b0) for (lo, hi), (b0, _) in zip(inter, bounds)
+        )
+        out[dst_sel] = block[src_sel]
+        covered += int(np.prod([hi - lo for lo, hi in inter]))
+    if covered != out.size:  # saved shards must tile the global domain
+        raise ValueError(
+            f"checkpoint {leaf_dir} does not cover region {bounds} "
+            f"({covered}/{out.size} elements found)"
+        )
+    return out
+
+
+def read_meta(path: str | Path) -> dict:
+    """The sharded checkpoint's metadata: ``{"step", "leaves": [{"path",
+    "shape", "dtype", "shards": [...]}, ...]}`` — lets callers inspect
+    saved shapes/dtypes before choosing a restore template (e.g. the
+    FSDP world-resize path in `Trainer.restore`)."""
+    return json.loads((Path(path) / "meta.json").read_text())
+
+
+def restore_sharded(path: str | Path, like: Any) -> tuple[Any, int]:
+    """Restore a sharded checkpoint into the structure AND shardings of
+    ``like`` (e.g. the freshly-initialized sharded train state).
+
+    Each ``jax.Array`` leaf is rebuilt with
+    ``jax.make_array_from_callback`` under the template's sharding, so
+    each process opens only the shard FILES that intersect the regions
+    its own devices need (aligned or coarser target shardings read a
+    subset; a fully cross-sharded target — e.g. row-saved, column-
+    restored — intersects every file) — and the target sharding is free
+    to differ from the one saved (FSDP-n ↔ FSDP-m ↔ replicated ↔ TP).
+    Non-``jax.Array`` template leaves get the fully-assembled numpy
+    array.  Returns ``(tree, step)``."""
+    import jax
+
+    path = Path(path)
+    meta = read_meta(path)
+    leaves_like, treedef = _flatten_with_paths(like)
+    saved_paths = [rec["path"] for rec in meta["leaves"]]
+    if [k for k, _ in leaves_like] != saved_paths:
+        raise ValueError(
+            f"sharded checkpoint {path} structure mismatch: "
+            f"{saved_paths[:3]}... vs {[k for k, _ in leaves_like][:3]}..."
+        )
+    out = []
+    for i, ((keypath, tmpl), rec) in enumerate(
+        zip(leaves_like, meta["leaves"], strict=True)
+    ):
+        shape, dtype = tuple(rec["shape"]), np.dtype(rec["dtype"])
+        if tuple(tmpl.shape) != shape or np.dtype(tmpl.dtype) != dtype:
+            raise ValueError(
+                f"leaf {keypath}: checkpoint has shape={shape} dtype={dtype}, "
+                f"template has shape={tuple(tmpl.shape)} dtype={np.dtype(tmpl.dtype)}"
+            )
+        leaf_dir = path / f"leaf_{i}"
+        if isinstance(tmpl, jax.Array) or hasattr(tmpl, "sharding"):
+            sharding = tmpl.sharding
+
+            def cb(index, _dir=leaf_dir, _rec=rec, _shape=shape, _dtype=dtype):
+                return _read_region(_dir, _rec, _norm_index(index, _shape), _dtype)
+
+            out.append(jax.make_array_from_callback(shape, sharding, cb))
+        else:
+            full = _read_region(
+                leaf_dir, rec, tuple((0, d) for d in shape), dtype
+            )
+            out.append(full)
+    return jax.tree_util.tree_unflatten(treedef, out), int(meta["step"])
 
 
 def restore(path: str | Path, like: Any) -> tuple[Any, int]:
