@@ -1,0 +1,150 @@
+"""Trainer(fsdp=True): the high-level loop over ZeRO-3 sharded state.
+
+Must match the replicated trainer's trajectory exactly (the FSDP update
+is elementwise on shards — test_fsdp.py proves the step; this proves the
+Trainer wiring: fit, sharded checkpointing, eval param gathering).
+"""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from tpu_dist import comm, data, models, train
+
+N = 8
+
+
+@pytest.fixture()
+def mesh(cpu_devices):
+    return comm.make_mesh(N, ("data",), mesh_devices=cpu_devices[:N])
+
+
+def _dataset():
+    return data.load_mnist("train", synthetic_size=256)
+
+
+def test_fsdp_trainer_matches_replicated(mesh):
+    ds = _dataset()
+    cfg = dict(epochs=2, global_batch=64, seed=1234)
+    t_rep = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, train.TrainConfig(**cfg)
+    )
+    t_fsdp = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh,
+        train.TrainConfig(fsdp=True, **cfg),
+    )
+    h_rep = t_rep.fit(ds)
+    h_fsdp = t_fsdp.fit(ds)
+    for a, b in zip(h_rep, h_fsdp, strict=True):
+        assert a.mean_loss == pytest.approx(b.mean_loss, rel=2e-4), (
+            f"epoch {a.epoch}: replicated {a.mean_loss} vs fsdp {b.mean_loss}"
+        )
+    # eval path gathers shards — same accuracy measured both ways
+    assert t_fsdp.evaluate(ds) == pytest.approx(t_rep.evaluate(ds), abs=0.02)
+
+
+def test_fsdp_trainer_state_is_sharded(mesh):
+    t = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh,
+        train.TrainConfig(fsdp=True),
+    )
+    leaf = jax.tree.leaves(t.params)[0]
+    assert leaf.shape[0] == N  # (n, k) row-sharded layout
+    assert len(leaf.sharding.device_set) == N
+    for s in leaf.addressable_shards:
+        assert s.data.shape[0] == 1  # 1/n of the leaf per device
+
+
+def test_fsdp_trainer_checkpoint_resume(tmp_path, mesh):
+    ds = _dataset()
+    cfg = train.TrainConfig(fsdp=True, epochs=2, global_batch=64)
+    t1 = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, cfg
+    )
+    t1.fit(ds, epochs=1, checkpoint_dir=str(tmp_path))
+    t1.fit(ds, epochs=2, start_epoch=1)
+
+    t2 = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, cfg
+    )
+    assert t2.restore(tmp_path / "ckpt_0.npz") == 1
+    t2.fit(ds, epochs=2, start_epoch=1)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        t1.params,
+        t2.params,
+    )
+
+
+def test_fsdp_checkpoint_world_resize(tmp_path, mesh, cpu_devices):
+    """A checkpoint written FSDP-8 restores into an FSDP-4 trainer (the
+    physical (n, k) layouts differ; the logical params must survive)."""
+    ds = _dataset()
+    cfg8 = train.TrainConfig(fsdp=True, epochs=1, global_batch=64)
+    t8 = train.Trainer(models.mnist_net(), models.IN_SHAPE, mesh, cfg8)
+    t8.fit(ds, epochs=1)
+    t8.save(tmp_path / "ck", epoch=1)
+
+    mesh4 = comm.make_mesh(4, ("data",), mesh_devices=cpu_devices[:4])
+    t4 = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh4,
+        train.TrainConfig(fsdp=True, epochs=1, global_batch=64),
+    )
+    assert t4.restore(tmp_path / "ck") == 1
+    # logical parameters identical after the resize
+    import jax as _jax
+
+    p8 = _jax.tree.map(np.asarray, t8.params)
+    p4 = _jax.tree.map(np.asarray, t4.params)
+    for a, b in zip(_jax.tree.leaves(p8), _jax.tree.leaves(p4), strict=True):
+        m = min(a.size, b.size)
+        np.testing.assert_array_equal(a.reshape(-1)[:m], b.reshape(-1)[:m])
+        assert not np.any(b.reshape(-1)[m:])  # any extra tail is padding
+    # and training continues (loss finite, same eval surface)
+    t4.fit(ds, epochs=1)
+    assert 0.0 <= t4.evaluate(ds) <= 1.0
+
+
+def test_fsdp_compiled_gather_matches_host_gather(mesh):
+    """The multi-host-safe compiled all_gather reassembly must equal the
+    host-side shard fetch (evaluate() picks between them)."""
+    from tpu_dist import parallel
+
+    params, _ = models.mnist_net().init(jax.random.key(0), models.IN_SHAPE)
+    sharded = parallel.fsdp_shard_params(params, mesh)
+    host = parallel.fsdp_gather_params(sharded, params)
+    compiled = parallel.fsdp_gather_params_compiled(sharded, params, mesh)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        host,
+        compiled,
+    )
+
+
+def test_fsdp_restore_rejects_foreign_checkpoint(tmp_path, mesh):
+    """A different model's sharded checkpoint must raise, not silently
+    flat-copy through the world-resize path."""
+    from tpu_dist.train import checkpoint
+
+    other = {"not_params": {"w": np.zeros((3, 3), np.float32)}}
+    checkpoint.save_sharded(tmp_path / "alien", other)
+    t = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh,
+        train.TrainConfig(fsdp=True),
+    )
+    with pytest.raises(ValueError, match="structure mismatch"):
+        t.restore(tmp_path / "alien")
+
+
+def test_fsdp_rejects_stateful_and_accum(mesh):
+    with pytest.raises(ValueError, match="stateless"):
+        train.Trainer(
+            models.resnet18(num_classes=10), (3, 32, 32), mesh,
+            train.TrainConfig(fsdp=True),
+        )
+    with pytest.raises(ValueError, match="accum_steps"):
+        train.Trainer(
+            models.mnist_net(), models.IN_SHAPE, mesh,
+            train.TrainConfig(fsdp=True, accum_steps=2),
+        )

@@ -37,6 +37,7 @@ from tpu_dist.parallel.pipeline import (
 )
 from tpu_dist.parallel.fsdp import (
     fsdp_gather_params,
+    fsdp_gather_params_compiled,
     fsdp_shard_params,
     make_fsdp_train_step,
 )
@@ -66,6 +67,7 @@ __all__ = [
     "MODEL_AXIS",
     "PIPE_AXIS",
     "fsdp_gather_params",
+    "fsdp_gather_params_compiled",
     "fsdp_shard_params",
     "gpipe_bubble_fraction",
     "gpipe_ticks",

@@ -76,6 +76,41 @@ def fsdp_gather_params(sharded: Any, template: Any) -> Any:
     )
 
 
+def fsdp_gather_params_compiled(
+    sharded: Any, template: Any, mesh: Mesh, axis_name: str = DATA_AXIS
+) -> Any:
+    """Reassemble full parameters INSIDE a compiled program — the
+    multi-host-safe sibling of `fsdp_gather_params` (which fetches shard
+    bytes to one host and raises when shards live on another process's
+    devices).  Each (n, k) leaf all-gathers its rows over ``axis_name``
+    and reshapes to the template's shape; the output is replicated, so
+    every process holds (and can read) the full tree."""
+    tmpl_struct = jax.tree.map(
+        lambda t: jax.ShapeDtypeStruct(tuple(t.shape), t.dtype), template
+    )
+
+    def gather(local):
+        def un(s, t):
+            full = lax.all_gather(s, axis_name, axis=0, tiled=True)
+            return full.reshape(-1)[: math.prod(t.shape)].reshape(t.shape)
+
+        return jax.tree.map(un, local, tmpl_struct)
+
+    mapped = jax.shard_map(
+        gather,
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(
+                lambda leaf: P(axis_name) if jnp.ndim(leaf) >= 1 else P(),
+                sharded,
+            ),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped)(sharded)
+
+
 def make_fsdp_train_step(
     loss_fn: Callable[..., Any],
     optimizer,

@@ -53,6 +53,11 @@ class TrainConfig:
     # microbatches scanned sequentially (activations HBM / accum_steps);
     # optimizer math unchanged (mean gradient over the global batch).
     accum_steps: int = 1
+    # FSDP (ZeRO-3): params/grads/optimizer state sharded 1/n over the
+    # mesh axis instead of replicated; checkpoints switch to the sharded
+    # per-shard-file format.  Numerics identical to replicated DP (the
+    # update is elementwise — tested in test_fsdp.py).
+    fsdp: bool = False
 
 
 @dataclass
@@ -87,17 +92,26 @@ class Trainer:
         # torch.manual_seed(1234) analog: all replicas share this init key.
         key = jax.random.key(self.config.seed)
         params, state = model.init(key, in_shape)
-        self.params = parallel.replicate(params, mesh)
-        self.model_state = parallel.replicate(state, mesh)
-        self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
-        # The step donates all three trees; any buffer shared between
-        # them (e.g. an optimizer init that returns params leaves
-        # uncopied — device_put maps equal inputs to ONE buffer) would be
-        # donated twice and desync/crash the compiled step.  Fail loudly
-        # here instead (SURVEY.md §5 donation check).
-        from tpu_dist.utils.debug import assert_no_aliasing
+        if self.config.fsdp and jax.tree.leaves(state):
+            raise ValueError(
+                "TrainConfig.fsdp supports stateless models only (no "
+                "BatchNorm running stats); use "
+                "parallel.make_fsdp_train_step directly for custom state"
+            )
+        if self.config.fsdp and self.config.accum_steps != 1:
+            raise ValueError("accum_steps > 1 is not supported with fsdp")
+        if not self.config.fsdp:
+            self.params = parallel.replicate(params, mesh)
+            self.model_state = parallel.replicate(state, mesh)
+            self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
+            # The step donates all three trees; any buffer shared between
+            # them (e.g. an optimizer init that returns params leaves
+            # uncopied — device_put maps equal inputs to ONE buffer) would be
+            # donated twice and desync/crash the compiled step.  Fail loudly
+            # here instead (SURVEY.md §5 donation check).
+            from tpu_dist.utils.debug import assert_no_aliasing
 
-        assert_no_aliasing(self.params, self.model_state, self.opt_state)
+            assert_no_aliasing(self.params, self.model_state, self.opt_state)
 
         compute_dtype = (
             jnp.dtype(self.config.compute_dtype)
@@ -129,10 +143,41 @@ class Trainer:
             scores, new_state = forward(params, model_state, x, key)
             return self._loss(scores, y), (new_state, {})
 
-        self.step = parallel.make_stateful_train_step(
-            loss_fn, self.optimizer, mesh,
-            accum_steps=self.config.accum_steps,
-        )
+        if self.config.fsdp:
+            # ZeRO-3 path: params/opt state live permanently sharded; the
+            # step wrapper keeps the stateful 5-tuple contract so fit()/
+            # callers are oblivious to the sharding strategy.
+            def fsdp_loss(p, batch, key):
+                x, y = batch
+                scores, _ = forward(p, state, x, key)
+                return self._loss(scores, y), {}
+
+            fstep, p_sh, o_sh = parallel.make_fsdp_train_step(
+                fsdp_loss, self.optimizer, mesh, params
+            )
+            # Same donation guard as the replicated path: the fsdp step
+            # donates both trees, so a buffer shared between them (e.g. an
+            # optimizer init returning param leaves uncopied) would be
+            # donated twice.
+            from tpu_dist.utils.debug import assert_no_aliasing
+
+            assert_no_aliasing(p_sh, o_sh)
+            self.params, self.opt_state = p_sh, o_sh
+            self.model_state = parallel.replicate(state, mesh)
+            self._param_template = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+            )
+
+            def fsdp_step(p, ms, os_, batch, key):
+                p2, o2, loss, aux = fstep(p, os_, batch, key)
+                return p2, ms, o2, loss, aux
+
+            self.step = fsdp_step
+        else:
+            self.step = parallel.make_stateful_train_step(
+                loss_fn, self.optimizer, mesh,
+                accum_steps=self.config.accum_steps,
+            )
         self._eval_apply = jax.jit(
             lambda params, state, x: model.apply(params, state, x, train=False)[0]
         )
@@ -144,6 +189,15 @@ class Trainer:
         file write overlaps subsequent training steps."""
         from tpu_dist.train import checkpoint
 
+        if self.config.fsdp:
+            # Sharded state: per-shard files, no global array materialized
+            # (``path`` becomes a directory — see checkpoint.save_sharded).
+            tree = {"params": self.params, "opt_state": self.opt_state}
+            if async_writer is not None:
+                async_writer.save_sharded(path, tree, step=epoch)
+            else:
+                checkpoint.save_sharded(path, tree, step=epoch)
+            return
         tree = {
             "params": self.params,
             "model_state": self.model_state,
@@ -159,6 +213,26 @@ class Trainer:
         (resume point)."""
         from tpu_dist.train import checkpoint
 
+        if self.config.fsdp:
+            like = {"params": self.params, "opt_state": self.opt_state}
+            # Decide the path up front from the metadata (no exception
+            # control flow: a corrupt checkpoint should raise its real
+            # error, not retry through the resize path).
+            meta = checkpoint.read_meta(path)
+            flat_like = jax.tree_util.tree_flatten_with_path(like)[0]
+            same_shapes = len(meta["leaves"]) == len(flat_like) and all(
+                tuple(rec["shape"]) == tuple(leaf.shape)
+                for rec, (_, leaf) in zip(meta["leaves"], flat_like)
+            )
+            if same_shapes:
+                restored, epoch = checkpoint.restore_sharded(path, like)
+            else:
+                # Checkpoint written at another world size (FSDP leaves
+                # are physically (world, k)).  Translate.
+                restored, epoch = self._restore_fsdp_resized(path, like)
+            self.params = restored["params"]
+            self.opt_state = restored["opt_state"]
+            return epoch
         like = {
             "params": self.params,
             "model_state": self.model_state,
@@ -169,6 +243,63 @@ class Trainer:
         self.model_state = parallel.replicate(state["model_state"], self.mesh)
         self.opt_state = parallel.replicate(state["opt_state"], self.mesh)
         return epoch
+
+    def _restore_fsdp_resized(self, path, like):
+        """Restore an FSDP checkpoint written at a DIFFERENT world size.
+
+        Every FSDP leaf is physically ``(n, k)``: the flattened logical
+        leaf zero-padded to ``n·k`` and row-sharded (fsdp_shard_params).
+        Padding stays exactly zero through training (padded grads are
+        zero — see fsdp.py), so translating ``n → n'`` is a flat copy of
+        ``min(n·k, n'·k')`` elements (any truncated/added tail is
+        padding) followed by a re-shard under the current mesh."""
+        from tpu_dist.train import checkpoint
+
+        meta = checkpoint.read_meta(path)
+        recs = meta["leaves"]
+        # Only a genuine world-size resize may take this path: the tree
+        # STRUCTURE (keypaths) must match exactly — otherwise a
+        # different model's checkpoint would silently flat-copy into
+        # truncated/zero-padded garbage.
+        with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+        paths = [jax.tree_util.keystr(p) for p, _ in with_paths]
+        if paths != [rec["path"] for rec in recs]:
+            raise ValueError(
+                f"fsdp checkpoint {path} structure mismatch: "
+                f"{[rec['path'] for rec in recs][:3]}... vs {paths[:3]}..."
+            )
+        leaves = [leaf for _, leaf in with_paths]
+        # Assemble each saved leaf fully on host (stub templates carry the
+        # SAVED shapes so restore_sharded does plain assembly).
+        stubs = [
+            np.broadcast_to(
+                np.zeros((), np.dtype(rec["dtype"])), tuple(rec["shape"])
+            )
+            for rec in recs
+        ]
+        full_tree, epoch = checkpoint.restore_sharded(
+            path, jax.tree_util.tree_unflatten(treedef, stubs)
+        )
+        out = []
+        for full, tmpl, rec in zip(
+            jax.tree_util.tree_flatten(full_tree)[0], leaves, recs, strict=True
+        ):
+            if not isinstance(tmpl, jax.Array):
+                out.append(full)
+                continue
+            if np.dtype(rec["dtype"]) != np.dtype(tmpl.dtype):
+                raise ValueError(
+                    f"leaf {rec['path']}: dtype {rec['dtype']} in checkpoint "
+                    f"vs {np.dtype(tmpl.dtype)} in trainer state"
+                )
+            src = np.asarray(full).reshape(-1)
+            tgt = np.zeros(int(np.prod(tmpl.shape)), src.dtype)
+            m = min(src.size, tgt.size)
+            tgt[:m] = src[:m]
+            out.append(
+                jax.device_put(tgt.reshape(tmpl.shape), tmpl.sharding)
+            )
+        return jax.tree_util.tree_unflatten(treedef, out), epoch
 
     def fit(
         self,
@@ -274,6 +405,20 @@ class Trainer:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         sharded = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        eval_params = self.params
+        if self.config.fsdp:  # reassemble once for the whole eval pass
+            if all(
+                leaf.is_fully_addressable
+                for leaf in jax.tree.leaves(self.params)
+            ):
+                eval_params = parallel.fsdp_gather_params(
+                    self.params, self._param_template
+                )
+            else:  # multi-host: gather inside a compiled program
+                eval_params = parallel.fsdp_gather_params_compiled(
+                    self.params, self._param_template, self.mesh,
+                    self.mesh.axis_names[0],
+                )
         correct = 0
         for i in range(0, n, batch_size):
             xs = dataset.images[i : i + batch_size]
@@ -283,7 +428,7 @@ class Trainer:
                 pad = batch_size - valid
                 xs = np.concatenate([xs, np.zeros((pad,) + xs.shape[1:], xs.dtype)])
             xs = jax.device_put(jnp.asarray(xs), sharded)
-            scores = self._eval_apply(self.params, self.model_state, xs)
+            scores = self._eval_apply(eval_params, self.model_state, xs)
             pred = np.asarray(scores).argmax(-1)[:valid]
             correct += int((pred == ys).sum())
         return correct / n
