@@ -38,8 +38,10 @@ from tpu_dist.parallel.pipeline import (
 from tpu_dist.parallel.fsdp import (
     fsdp_gather_params,
     fsdp_gather_params_compiled,
+    fsdp_full_params,
     fsdp_shard_params,
     make_fsdp_train_step,
+    make_zero1_train_step,
 )
 from tpu_dist.parallel.ulysses import ulysses_attention
 from tpu_dist.parallel.tensor_parallel import (
@@ -68,6 +70,7 @@ __all__ = [
     "PIPE_AXIS",
     "fsdp_gather_params",
     "fsdp_gather_params_compiled",
+    "fsdp_full_params",
     "fsdp_shard_params",
     "gpipe_bubble_fraction",
     "gpipe_ticks",
@@ -92,6 +95,7 @@ __all__ = [
     "tp_mlp_block",
     "tp_vocab_cross_entropy",
     "make_fsdp_train_step",
+    "make_zero1_train_step",
     "make_stateful_train_step",
     "make_train_step",
     "make_train_step_auto",

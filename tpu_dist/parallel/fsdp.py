@@ -40,6 +40,53 @@ def _pad_rows(flat: jax.Array, n: int) -> jax.Array:
     return pad_to_multiple(flat, n).reshape(n, -1)
 
 
+# Shared building blocks of the ZeRO family (used by both ZeRO-3 and
+# ZeRO-1 steps — keep them in one place so a fix applies to both paths).
+
+
+def _unshard_rows(rows: Any, template: Any, axis_name: str) -> Any:
+    """all_gather each local (1, k) row back into its full logical leaf
+    (inside shard_map)."""
+
+    def un(s, t):
+        full = lax.all_gather(s, axis_name, axis=0, tiled=True)
+        return full.reshape(-1)[: math.prod(t.shape)].reshape(t.shape)
+
+    return jax.tree.map(un, rows, template)
+
+
+def _reduce_scatter_grads(grads: Any, n: int, axis_name: str) -> Any:
+    """Flat-pad each grad to (n, k) then ReduceScatter / n: rank r
+    reduces exactly its row (inside shard_map)."""
+    return jax.tree.map(
+        lambda g: lax.psum_scatter(
+            _pad_rows(jnp.ravel(g), n), axis_name,
+            scatter_dimension=0, tiled=True,
+        )
+        / n,
+        grads,
+    )
+
+
+def _spec_of(axis_name: str):
+    """Per-leaf partition spec: (n, k) leaves sharded over the axis,
+    scalar leaves (e.g. a schedule step counter) replicated."""
+    return lambda leaf: P(axis_name) if jnp.ndim(leaf) >= 1 else P()
+
+
+def _commit_scalars(tree: Any, mesh: Mesh) -> Any:
+    """Commit scalar leaves (step counters) to the mesh, replicated:
+    uncommitted single-device scalars round-trip through sharded
+    checkpoints as committed device-0 arrays, which then clash with the
+    mesh-wide step at dispatch."""
+    return jax.tree.map(
+        lambda l: l
+        if jnp.ndim(l) >= 1
+        else jax.device_put(l, NamedSharding(mesh, P())),
+        tree,
+    )
+
+
 def fsdp_shard_params(params: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
     """Shard a full parameter pytree: every leaf becomes an ``(n, k)``
     array sharded ``P(axis_name)`` (row r on rank r, zero-padded)."""
@@ -89,15 +136,8 @@ def fsdp_gather_params_compiled(
         lambda t: jax.ShapeDtypeStruct(tuple(t.shape), t.dtype), template
     )
 
-    def gather(local):
-        def un(s, t):
-            full = lax.all_gather(s, axis_name, axis=0, tiled=True)
-            return full.reshape(-1)[: math.prod(t.shape)].reshape(t.shape)
-
-        return jax.tree.map(un, local, tmpl_struct)
-
     mapped = jax.shard_map(
-        gather,
+        lambda local: _unshard_rows(local, tmpl_struct, axis_name),
         mesh=mesh,
         in_specs=(
             jax.tree.map(
@@ -141,46 +181,23 @@ def make_fsdp_train_step(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
     )
     sharded_params = fsdp_shard_params(params, mesh, axis_name)
-    opt_state = optimizer.init(sharded_params)
-
-    def unshard(local_shards):
-        def un(s, t):
-            full = lax.all_gather(s, axis_name, axis=0, tiled=True)
-            return full.reshape(-1)[: math.prod(t.shape)].reshape(t.shape)
-
-        return jax.tree.map(un, local_shards, template)
-
-    def shard_grads(grads):
-        # flat-pad to (n, k) then ReduceScatter: rank r reduces row r.
-        return jax.tree.map(
-            lambda g: lax.psum_scatter(
-                _pad_rows(jnp.ravel(g), n), axis_name,
-                scatter_dimension=0, tiled=True,
-            )
-            / n,
-            grads,
-        )
+    opt_state = _commit_scalars(optimizer.init(sharded_params), mesh)
 
     def spmd_step(local_shards, opt_state, batch, key):
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        full = unshard(local_shards)
+        full = _unshard_rows(local_shards, template, axis_name)
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             full, batch, key
         )
-        gshards = shard_grads(grads)
+        gshards = _reduce_scatter_grads(grads, n, axis_name)
         new_shards, new_opt = optimizer.update(local_shards, gshards, opt_state)
         # aux mirrors make_stateful_train_step's contract: float leaves
         # are cross-rank means, not one rank's local value.
         aux = _pmean_float_leaves(aux, axis_name)
         return new_shards, new_opt, lax.pmean(loss, axis_name), aux
 
-    # Per-leaf specs: (n, k) leaves are sharded on the axis; scalar leaves
-    # (e.g. a schedule step counter) are replicated.
-    def spec_of(leaf):
-        return P(axis_name) if jnp.ndim(leaf) >= 1 else P()
-
-    p_specs = jax.tree.map(spec_of, sharded_params)
-    o_specs = jax.tree.map(spec_of, opt_state)
+    p_specs = jax.tree.map(_spec_of(axis_name), sharded_params)
+    o_specs = jax.tree.map(_spec_of(axis_name), opt_state)
     mapped = jax.shard_map(
         spmd_step,
         mesh=mesh,
@@ -190,3 +207,97 @@ def make_fsdp_train_step(
     )
     step = jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
     return step, sharded_params, opt_state
+
+
+def fsdp_full_params(
+    sharded: Any, template: Any, mesh: Mesh, axis_name: str = DATA_AXIS
+) -> Any:
+    """Reassemble full parameters, choosing the cheap host fetch when
+    every shard is process-local and the compiled all_gather
+    (`fsdp_gather_params_compiled`) on multi-host meshes."""
+    if all(
+        getattr(leaf, "is_fully_addressable", True)
+        for leaf in jax.tree.leaves(sharded)
+    ):
+        return fsdp_gather_params(sharded, template)
+    return fsdp_gather_params_compiled(sharded, template, mesh, axis_name)
+
+
+def make_zero1_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer,
+    mesh: Mesh,
+    params: Any,
+    *,
+    axis_name: str = DATA_AXIS,
+    donate: bool = True,
+):
+    """ZeRO-1: replicated parameters, SHARDED optimizer state — the
+    middle point between replicated DP and FSDP/ZeRO-3.
+
+    Forward/backward run on the full replicated params (none of ZeRO-3's
+    per-step parameter all_gathers); gradients are reduce-scattered so
+    each rank holds one (1, k) row of every padded-flat leaf and updates
+    only its row — optimizer state (momentum/Adam moments) is therefore
+    born sharded, 1/n memory per rank; the updated rows all_gather back
+    into full parameters.  RS + shard-update + AG costs the same wire
+    traffic as the replicated path's allreduce (the tuto.md:354
+    identity), and the elementwise optimizer math makes the trajectory
+    identical to replicated DP to fp tolerance.  (ZeRO-2's gradient
+    sharding is implicit here: the reduce-scatter means full gradients
+    never persist — XLA frees them within the step.)
+
+    Returns ``(step, replicated_params, sharded_opt_state)`` with
+    ``step(params, opt_state, batch, key) -> (params, opt_state, loss,
+    aux)`` — params replicated, batch sharded on its leading axis.
+    """
+    n = mesh.shape[axis_name]
+    template = jax.tree.map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+    )
+    replicated = jax.tree.map(
+        lambda p: jax.device_put(jnp.asarray(p), NamedSharding(mesh, P())),
+        params,
+    )
+    # Optimizer state over the (1, k)-per-rank row shards.
+    opt_state = _commit_scalars(
+        optimizer.init(fsdp_shard_params(params, mesh, axis_name)), mesh
+    )
+
+    def local_rows(full):
+        """This rank's (1, k) row of each padded-flat leaf."""
+        r = lax.axis_index(axis_name)
+        return jax.tree.map(
+            lambda p: lax.dynamic_slice_in_dim(
+                _pad_rows(jnp.ravel(p), n), r, 1, axis=0
+            ),
+            full,
+        )
+
+    def spmd_step(full_params, opt_state, batch, key):
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            full_params, batch, key
+        )
+        gshards = _reduce_scatter_grads(grads, n, axis_name)
+        new_rows, new_opt = optimizer.update(
+            local_rows(full_params), gshards, opt_state
+        )
+        aux = _pmean_float_leaves(aux, axis_name)
+        return (
+            _unshard_rows(new_rows, template, axis_name),
+            new_opt,
+            lax.pmean(loss, axis_name),
+            aux,
+        )
+
+    o_specs = jax.tree.map(_spec_of(axis_name), opt_state)
+    mapped = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(P(), o_specs, P(axis_name), P()),
+        out_specs=(P(), o_specs, P(), P()),
+        check_vma=False,
+    )
+    step = jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+    return step, replicated, opt_state
