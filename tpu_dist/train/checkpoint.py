@@ -240,7 +240,10 @@ def _plan_sharded_save(tree: Any) -> tuple[list[dict], list[tuple[str, tuple, by
             starts = tuple(
                 b[0] for b in _norm_index(shard.index, tuple(leaf.shape))
             )
-            data = np.ascontiguousarray(np.asarray(shard.data))
+            # NB: tobytes() copies in C order from any layout; don't use
+            # ascontiguousarray here — it promotes 0-d shards to (1,),
+            # corrupting the recorded shape for scalar leaves.
+            data = np.asarray(shard.data)
             blobs.append(
                 (f"leaf_{i}/{_shard_filename(starts)}", data.shape, data.tobytes())
             )
@@ -384,6 +387,70 @@ def restore_sharded(path: str | Path, like: Any) -> tuple[Any, int]:
             )
             out.append(full)
     return jax.tree_util.tree_unflatten(treedef, out), int(meta["step"])
+
+
+def restore_fsdp(path: str | Path, like: Any) -> tuple[Any, int]:
+    """Restore a sharded checkpoint of FSDP/ZeRO state, translating
+    between WORLD SIZES when needed.
+
+    FSDP leaves are physically ``(n, k)``: the flattened logical leaf
+    zero-padded to ``n·k`` and row-sharded (`fsdp_shard_params`), and the
+    padding stays exactly zero through training (padded grads are zero).
+    So when the checkpoint's ``n`` differs from the template's, the
+    translation is a flat copy of ``min(n·k, n'·k')`` elements (any
+    truncated or added tail is padding) followed by a re-shard under the
+    template's sharding.  Same-shape checkpoints take the plain
+    `restore_sharded` path (per-region reads, no full host assembly).
+
+    The tree STRUCTURE (keypaths) must match exactly either way — a
+    different model's checkpoint raises instead of silently flat-copying
+    into garbage."""
+    import jax
+
+    path = Path(path)
+    meta = read_meta(path)
+    recs = meta["leaves"]
+    with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    paths = [jax.tree_util.keystr(p) for p, _ in with_paths]
+    if paths != [rec["path"] for rec in recs]:
+        raise ValueError(
+            f"fsdp checkpoint {path} structure mismatch: "
+            f"{[rec['path'] for rec in recs][:3]}... vs {paths[:3]}..."
+        )
+    leaves = [leaf for _, leaf in with_paths]
+    if all(
+        tuple(rec["shape"]) == tuple(leaf.shape)
+        for rec, leaf in zip(recs, leaves)
+    ):
+        return restore_sharded(path, like)
+
+    # World-size translation: assemble each saved leaf fully on host
+    # (stub templates carry the SAVED shapes), then flat-copy.
+    stubs = [
+        np.broadcast_to(np.zeros((), np.dtype(rec["dtype"])), tuple(rec["shape"]))
+        for rec in recs
+    ]
+    full_tree, epoch = restore_sharded(
+        path, jax.tree_util.tree_unflatten(treedef, stubs)
+    )
+    out = []
+    for full, tmpl, rec in zip(
+        jax.tree_util.tree_flatten(full_tree)[0], leaves, recs, strict=True
+    ):
+        if not isinstance(tmpl, jax.Array):
+            out.append(full)
+            continue
+        if np.dtype(rec["dtype"]) != np.dtype(tmpl.dtype):
+            raise ValueError(
+                f"leaf {rec['path']}: dtype {rec['dtype']} in checkpoint "
+                f"vs {np.dtype(tmpl.dtype)} in the template"
+            )
+        src = np.asarray(full).reshape(-1)
+        tgt = np.zeros(int(np.prod(tmpl.shape)), src.dtype)
+        m = min(src.size, tgt.size)
+        tgt[:m] = src[:m]
+        out.append(jax.device_put(tgt.reshape(tmpl.shape), tmpl.sharding))
+    return jax.tree_util.tree_unflatten(treedef, out), epoch
 
 
 def restore(path: str | Path, like: Any) -> tuple[Any, int]:

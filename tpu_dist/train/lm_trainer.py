@@ -38,6 +38,11 @@ class LMTrainConfig:
     seed: int = 1234
     accum_steps: int = 1
     compute_dtype: str | None = None  # e.g. "bfloat16"
+    # ZeRO-3: params/grads/opt state sharded 1/n (LMs are stateless so
+    # the step swap is transparent; checkpoints switch to the sharded
+    # format; val perplexity / generate gather params as needed).
+    # Not combinable with accum_steps > 1.
+    fsdp: bool = False
     log: Callable[[str], None] = print
 
 
@@ -68,12 +73,10 @@ class LMTrainer:
         self.world = int(np.prod(mesh.devices.shape))
         self.optimizer = optimizer or adamw(self.config.lr)
 
+        if self.config.fsdp and self.config.accum_steps != 1:
+            raise ValueError("accum_steps > 1 is not supported with fsdp")
         params, _ = lm.init(jax.random.key(self.config.seed))
-        self.params = parallel.replicate(params, mesh)
-        self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
         from tpu_dist.utils.debug import assert_no_aliasing
-
-        assert_no_aliasing(self.params, self.opt_state)
 
         compute = (
             jnp.dtype(self.config.compute_dtype)
@@ -81,23 +84,61 @@ class LMTrainer:
             else None
         )
 
+        def cast(p):
+            if compute is None:
+                return p
+            return jax.tree.map(
+                lambda a: a.astype(compute)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else a,
+                p,
+            )
+
         def loss_fn(p, s, batch, key):
             (tokens,) = batch
-            if compute is not None:
-                p = jax.tree.map(
-                    lambda a: a.astype(compute)
-                    if jnp.issubdtype(a.dtype, jnp.floating)
-                    else a,
-                    p,
-                )
-            logits, _ = self.lm.apply(p, {}, tokens)
+            logits, _ = self.lm.apply(cast(p), {}, tokens)
             return lm_loss(logits.astype(jnp.float32), tokens), ({}, {})
 
-        self.step = parallel.make_stateful_train_step(
-            loss_fn, self.optimizer, mesh,
-            accum_steps=self.config.accum_steps,
-        )
+        if self.config.fsdp:
+
+            def fsdp_loss(p, batch, key):
+                (tokens,) = batch
+                logits, _ = self.lm.apply(cast(p), {}, tokens)
+                return lm_loss(logits.astype(jnp.float32), tokens), {}
+
+            fstep, p_sh, o_sh = parallel.make_fsdp_train_step(
+                fsdp_loss, self.optimizer, mesh, params
+            )
+            assert_no_aliasing(p_sh, o_sh)
+            self.params, self.opt_state = p_sh, o_sh
+            self._param_template = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+            )
+
+            def fsdp_step(p, ms, os_, batch, key):
+                p2, o2, loss, aux = fstep(p, os_, batch, key)
+                return p2, ms, o2, loss, aux
+
+            self.step = fsdp_step
+        else:
+            self.params = parallel.replicate(params, mesh)
+            self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
+            assert_no_aliasing(self.params, self.opt_state)
+            self.step = parallel.make_stateful_train_step(
+                loss_fn, self.optimizer, mesh,
+                accum_steps=self.config.accum_steps,
+            )
         self._model_state = parallel.replicate({}, mesh)
+
+    def _full_params(self):
+        """Full (logical-shape) parameters for eval/decode — identity for
+        the replicated path, shard reassembly under FSDP."""
+        if not self.config.fsdp:
+            return self.params
+        return parallel.fsdp_full_params(
+            self.params, self._param_template, self.mesh,
+            parallel.DATA_AXIS,  # the axis make_fsdp_train_step sharded over
+        )
 
     def fit(
         self,
@@ -151,7 +192,7 @@ class LMTrainer:
             tps = steps_per_epoch * gb * s / dt
             vloss = vppl = None
             if val_windows is not None:
-                host = jax.tree.map(np.asarray, self.params)
+                host = jax.tree.map(np.asarray, self._full_params())
                 vloss, vppl = lm_perplexity(
                     self.lm, host, np.asarray(val_windows),
                     batch=min(64, len(val_windows)),
@@ -164,11 +205,17 @@ class LMTrainer:
                 LMEpochStats(epoch, mean, dt, tps, vloss, vppl)
             )
             if checkpoint_dir:
-                writer.save(
-                    f"{checkpoint_dir}/lm_ckpt_{epoch}.npz",
-                    {"params": self.params, "opt_state": self.opt_state},
-                    step=epoch + 1,
-                )
+                tree = {"params": self.params, "opt_state": self.opt_state}
+                if self.config.fsdp:
+                    writer.save_sharded(
+                        f"{checkpoint_dir}/lm_ckpt_{epoch}.npz", tree,
+                        step=epoch + 1,
+                    )
+                else:
+                    writer.save(
+                        f"{checkpoint_dir}/lm_ckpt_{epoch}.npz", tree,
+                        step=epoch + 1,
+                    )
         if writer is not None:
             writer.wait()
         return history
@@ -177,6 +224,11 @@ class LMTrainer:
         from tpu_dist.train import checkpoint
 
         like = {"params": self.params, "opt_state": self.opt_state}
+        if self.config.fsdp:
+            state, epoch = checkpoint.restore_fsdp(path, like)
+            self.params = state["params"]
+            self.opt_state = state["opt_state"]
+            return epoch
         state, epoch = checkpoint.restore(path, like)
         self.params = parallel.replicate(state["params"], self.mesh)
         self.opt_state = parallel.replicate(state["opt_state"], self.mesh)
@@ -184,7 +236,8 @@ class LMTrainer:
 
     def generate(self, prompt, steps: int, **kw):
         """Decode with the current parameters (replicated device arrays
-        feed the compiled decode directly)."""
+        feed the compiled decode directly; FSDP shards are reassembled
+        first)."""
         return self.lm.generate(
-            self.params, jnp.asarray(np.asarray(prompt)), steps, **kw
+            self._full_params(), jnp.asarray(np.asarray(prompt)), steps, **kw
         )

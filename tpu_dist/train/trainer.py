@@ -215,21 +215,7 @@ class Trainer:
 
         if self.config.fsdp:
             like = {"params": self.params, "opt_state": self.opt_state}
-            # Decide the path up front from the metadata (no exception
-            # control flow: a corrupt checkpoint should raise its real
-            # error, not retry through the resize path).
-            meta = checkpoint.read_meta(path)
-            flat_like = jax.tree_util.tree_flatten_with_path(like)[0]
-            same_shapes = len(meta["leaves"]) == len(flat_like) and all(
-                tuple(rec["shape"]) == tuple(leaf.shape)
-                for rec, (_, leaf) in zip(meta["leaves"], flat_like)
-            )
-            if same_shapes:
-                restored, epoch = checkpoint.restore_sharded(path, like)
-            else:
-                # Checkpoint written at another world size (FSDP leaves
-                # are physically (world, k)).  Translate.
-                restored, epoch = self._restore_fsdp_resized(path, like)
+            restored, epoch = checkpoint.restore_fsdp(path, like)
             self.params = restored["params"]
             self.opt_state = restored["opt_state"]
             return epoch
@@ -243,63 +229,6 @@ class Trainer:
         self.model_state = parallel.replicate(state["model_state"], self.mesh)
         self.opt_state = parallel.replicate(state["opt_state"], self.mesh)
         return epoch
-
-    def _restore_fsdp_resized(self, path, like):
-        """Restore an FSDP checkpoint written at a DIFFERENT world size.
-
-        Every FSDP leaf is physically ``(n, k)``: the flattened logical
-        leaf zero-padded to ``n·k`` and row-sharded (fsdp_shard_params).
-        Padding stays exactly zero through training (padded grads are
-        zero — see fsdp.py), so translating ``n → n'`` is a flat copy of
-        ``min(n·k, n'·k')`` elements (any truncated/added tail is
-        padding) followed by a re-shard under the current mesh."""
-        from tpu_dist.train import checkpoint
-
-        meta = checkpoint.read_meta(path)
-        recs = meta["leaves"]
-        # Only a genuine world-size resize may take this path: the tree
-        # STRUCTURE (keypaths) must match exactly — otherwise a
-        # different model's checkpoint would silently flat-copy into
-        # truncated/zero-padded garbage.
-        with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
-        paths = [jax.tree_util.keystr(p) for p, _ in with_paths]
-        if paths != [rec["path"] for rec in recs]:
-            raise ValueError(
-                f"fsdp checkpoint {path} structure mismatch: "
-                f"{[rec['path'] for rec in recs][:3]}... vs {paths[:3]}..."
-            )
-        leaves = [leaf for _, leaf in with_paths]
-        # Assemble each saved leaf fully on host (stub templates carry the
-        # SAVED shapes so restore_sharded does plain assembly).
-        stubs = [
-            np.broadcast_to(
-                np.zeros((), np.dtype(rec["dtype"])), tuple(rec["shape"])
-            )
-            for rec in recs
-        ]
-        full_tree, epoch = checkpoint.restore_sharded(
-            path, jax.tree_util.tree_unflatten(treedef, stubs)
-        )
-        out = []
-        for full, tmpl, rec in zip(
-            jax.tree_util.tree_flatten(full_tree)[0], leaves, recs, strict=True
-        ):
-            if not isinstance(tmpl, jax.Array):
-                out.append(full)
-                continue
-            if np.dtype(rec["dtype"]) != np.dtype(tmpl.dtype):
-                raise ValueError(
-                    f"leaf {rec['path']}: dtype {rec['dtype']} in checkpoint "
-                    f"vs {np.dtype(tmpl.dtype)} in trainer state"
-                )
-            src = np.asarray(full).reshape(-1)
-            tgt = np.zeros(int(np.prod(tmpl.shape)), src.dtype)
-            m = min(src.size, tgt.size)
-            tgt[:m] = src[:m]
-            out.append(
-                jax.device_put(tgt.reshape(tmpl.shape), tmpl.sharding)
-            )
-        return jax.tree_util.tree_unflatten(treedef, out), epoch
 
     def fit(
         self,
@@ -407,18 +336,10 @@ class Trainer:
         sharded = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
         eval_params = self.params
         if self.config.fsdp:  # reassemble once for the whole eval pass
-            if all(
-                leaf.is_fully_addressable
-                for leaf in jax.tree.leaves(self.params)
-            ):
-                eval_params = parallel.fsdp_gather_params(
-                    self.params, self._param_template
-                )
-            else:  # multi-host: gather inside a compiled program
-                eval_params = parallel.fsdp_gather_params_compiled(
-                    self.params, self._param_template, self.mesh,
-                    self.mesh.axis_names[0],
-                )
+            eval_params = parallel.fsdp_full_params(
+                self.params, self._param_template, self.mesh,
+                parallel.DATA_AXIS,  # the axis make_fsdp_train_step sharded over
+            )
         correct = 0
         for i in range(0, n, batch_size):
             xs = dataset.images[i : i + batch_size]
