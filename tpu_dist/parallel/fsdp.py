@@ -159,6 +159,7 @@ def make_fsdp_train_step(
     *,
     axis_name: str = DATA_AXIS,
     donate: bool = True,
+    grad_pmean_axes: tuple[str, ...] = (),
 ):
     """Build the compiled FSDP train step.
 
@@ -168,8 +169,16 @@ def make_fsdp_train_step(
       optimizer: `tpu_dist.train.optim.Optimizer`; its state is created
         over the SHARDED leaves, so it is 1/n per rank by construction.
       mesh: mesh whose ``axis_name`` axis shards batch AND model state.
+        May have MORE axes than ``axis_name`` — params/opt state are then
+        replicated over the extra axes and ``loss_fn`` is free to use
+        them (e.g. tensor parallelism over a 'model' axis).
       params: the full initial parameter pytree (consumed: returned
         sharded).
+      grad_pmean_axes: extra mesh axes to pmean gradients over BEFORE
+        the ``axis_name`` reduce-scatter.  For FSDP x TP composition
+        pass ``('model',)``: per the TP gradient contract
+        (test_tensor_parallel.py), the model-axis mean of
+        `loss_tensor_parallel` grads equals the dense gradient.
 
     Returns ``(step, sharded_params, opt_state)`` with
     ``step(sharded_params, opt_state, batch, key) -> (sharded_params,
@@ -189,12 +198,18 @@ def make_fsdp_train_step(
         (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             full, batch, key
         )
+        if grad_pmean_axes:  # e.g. the TP model axis (gradient contract)
+            grads = jax.tree.map(
+                lambda g: lax.pmean(g, grad_pmean_axes), grads
+            )
         gshards = _reduce_scatter_grads(grads, n, axis_name)
         new_shards, new_opt = optimizer.update(local_shards, gshards, opt_state)
         # aux mirrors make_stateful_train_step's contract: float leaves
-        # are cross-rank means, not one rank's local value.
-        aux = _pmean_float_leaves(aux, axis_name)
-        return new_shards, new_opt, lax.pmean(loss, axis_name), aux
+        # are cross-rank means, not one rank's local value.  Loss/aux
+        # reduce over the extra axes too so the P() out_spec is honest.
+        all_axes = (axis_name, *grad_pmean_axes)
+        aux = _pmean_float_leaves(aux, all_axes)
+        return new_shards, new_opt, lax.pmean(loss, all_axes), aux
 
     p_specs = jax.tree.map(_spec_of(axis_name), sharded_params)
     o_specs = jax.tree.map(_spec_of(axis_name), opt_state)
