@@ -43,6 +43,10 @@ class LMTrainConfig:
     # format; val perplexity / generate gather params as needed).
     # Not combinable with accum_steps > 1.
     fsdp: bool = False
+    # ZeRO-1: params replicated, optimizer state sharded 1/n.  Mutually
+    # exclusive with fsdp; same sharded checkpoint format and
+    # accum_steps restriction.
+    zero1: bool = False
     log: Callable[[str], None] = print
 
 
@@ -73,8 +77,11 @@ class LMTrainer:
         self.world = int(np.prod(mesh.devices.shape))
         self.optimizer = optimizer or adamw(self.config.lr)
 
-        if self.config.fsdp and self.config.accum_steps != 1:
-            raise ValueError("accum_steps > 1 is not supported with fsdp")
+        self._sharded_mode = self.config.fsdp or self.config.zero1
+        if self.config.fsdp and self.config.zero1:
+            raise ValueError("fsdp and zero1 are mutually exclusive")
+        if self._sharded_mode and self.config.accum_steps != 1:
+            raise ValueError("accum_steps > 1 is not supported with fsdp/zero1")
         params, _ = lm.init(jax.random.key(self.config.seed))
         from tpu_dist.utils.debug import assert_no_aliasing
 
@@ -99,14 +106,19 @@ class LMTrainer:
             logits, _ = self.lm.apply(cast(p), {}, tokens)
             return lm_loss(logits.astype(jnp.float32), tokens), ({}, {})
 
-        if self.config.fsdp:
+        if self._sharded_mode:
 
             def fsdp_loss(p, batch, key):
                 (tokens,) = batch
                 logits, _ = self.lm.apply(cast(p), {}, tokens)
                 return lm_loss(logits.astype(jnp.float32), tokens), {}
 
-            fstep, p_sh, o_sh = parallel.make_fsdp_train_step(
+            make = (
+                parallel.make_fsdp_train_step
+                if self.config.fsdp
+                else parallel.make_zero1_train_step
+            )
+            fstep, p_sh, o_sh = make(
                 fsdp_loss, self.optimizer, mesh, params
             )
             assert_no_aliasing(p_sh, o_sh)
@@ -206,7 +218,7 @@ class LMTrainer:
             )
             if checkpoint_dir:
                 tree = {"params": self.params, "opt_state": self.opt_state}
-                if self.config.fsdp:
+                if self._sharded_mode:
                     writer.save_sharded(
                         f"{checkpoint_dir}/lm_ckpt_{epoch}.npz", tree,
                         step=epoch + 1,
@@ -224,7 +236,7 @@ class LMTrainer:
         from tpu_dist.train import checkpoint
 
         like = {"params": self.params, "opt_state": self.opt_state}
-        if self.config.fsdp:
+        if self._sharded_mode:
             state, epoch = checkpoint.restore_fsdp(path, like)
             self.params = state["params"]
             self.opt_state = state["opt_state"]

@@ -58,6 +58,10 @@ class TrainConfig:
     # per-shard-file format.  Numerics identical to replicated DP (the
     # update is elementwise — tested in test_fsdp.py).
     fsdp: bool = False
+    # ZeRO-1: params replicated, optimizer state sharded 1/n (the memory
+    # middle point; same wire cost and trajectory as replicated DP).
+    # Mutually exclusive with fsdp; same sharded checkpoint format.
+    zero1: bool = False
 
 
 @dataclass
@@ -92,15 +96,18 @@ class Trainer:
         # torch.manual_seed(1234) analog: all replicas share this init key.
         key = jax.random.key(self.config.seed)
         params, state = model.init(key, in_shape)
-        if self.config.fsdp and jax.tree.leaves(state):
+        sharded_mode = self.config.fsdp or self.config.zero1
+        if self.config.fsdp and self.config.zero1:
+            raise ValueError("fsdp and zero1 are mutually exclusive")
+        if sharded_mode and jax.tree.leaves(state):
             raise ValueError(
-                "TrainConfig.fsdp supports stateless models only (no "
+                "TrainConfig.fsdp/zero1 support stateless models only (no "
                 "BatchNorm running stats); use "
                 "parallel.make_fsdp_train_step directly for custom state"
             )
-        if self.config.fsdp and self.config.accum_steps != 1:
-            raise ValueError("accum_steps > 1 is not supported with fsdp")
-        if not self.config.fsdp:
+        if sharded_mode and self.config.accum_steps != 1:
+            raise ValueError("accum_steps > 1 is not supported with fsdp/zero1")
+        if not sharded_mode:
             self.params = parallel.replicate(params, mesh)
             self.model_state = parallel.replicate(state, mesh)
             self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
@@ -143,16 +150,22 @@ class Trainer:
             scores, new_state = forward(params, model_state, x, key)
             return self._loss(scores, y), (new_state, {})
 
-        if self.config.fsdp:
-            # ZeRO-3 path: params/opt state live permanently sharded; the
-            # step wrapper keeps the stateful 5-tuple contract so fit()/
-            # callers are oblivious to the sharding strategy.
+        if sharded_mode:
+            # ZeRO path: optimizer state (and, for fsdp, params) live
+            # permanently sharded; the step wrapper keeps the stateful
+            # 5-tuple contract so fit()/callers are oblivious to the
+            # sharding strategy.
             def fsdp_loss(p, batch, key):
                 x, y = batch
                 scores, _ = forward(p, state, x, key)
                 return self._loss(scores, y), {}
 
-            fstep, p_sh, o_sh = parallel.make_fsdp_train_step(
+            make = (
+                parallel.make_fsdp_train_step
+                if self.config.fsdp
+                else parallel.make_zero1_train_step
+            )
+            fstep, p_sh, o_sh = make(
                 fsdp_loss, self.optimizer, mesh, params
             )
             # Same donation guard as the replicated path: the fsdp step
@@ -189,7 +202,7 @@ class Trainer:
         file write overlaps subsequent training steps."""
         from tpu_dist.train import checkpoint
 
-        if self.config.fsdp:
+        if self.config.fsdp or self.config.zero1:
             # Sharded state: per-shard files, no global array materialized
             # (``path`` becomes a directory — see checkpoint.save_sharded).
             tree = {"params": self.params, "opt_state": self.opt_state}
@@ -213,7 +226,7 @@ class Trainer:
         (resume point)."""
         from tpu_dist.train import checkpoint
 
-        if self.config.fsdp:
+        if self.config.fsdp or self.config.zero1:
             like = {"params": self.params, "opt_state": self.opt_state}
             restored, epoch = checkpoint.restore_fsdp(path, like)
             self.params = restored["params"]
