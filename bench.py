@@ -28,25 +28,80 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def ensure_live_backend(timeout_s: float = 120.0) -> None:
+def ensure_live_backend(
+    probe_timeout_s: float = 90.0, budget_s: float = 540.0
+) -> None:
     """Probe the default JAX backend in a SUBPROCESS first: in this
     container the TPU is reached through a tunnel that can hang
-    indefinitely at init, which would wedge the whole benchmark.  If the
-    probe can't produce devices in time, pin this process to CPU so the
-    bench always emits its JSON line (flagging the fallback on stderr).
+    indefinitely at init, which would wedge the whole benchmark.  The
+    tunnel also FLAPS (observed alive ~35 min out of a 2.5h round), so a
+    single probe at an arbitrary moment mostly records CPU even when TPU
+    time existed — retry with backoff across ``budget_s`` before giving
+    up.  If no probe succeeds, pin this process to CPU so the bench
+    always emits its JSON line (flagging the fallback on stderr).
 
     The probe must EXECUTE a computation and read the result back, not
     just enumerate devices — the tunnel has a half-alive failure mode
     where ``jax.devices()`` answers but any compile/execute hangs."""
     from tpu_dist.utils.platform import probe_default_backend, pin_cpu
 
-    platform, detail = probe_default_backend(timeout_s)
-    if platform is not None:
-        log(f"backend probe: {platform}")
-        return
+    deadline = time.monotonic() + budget_s
+    attempt, detail = 0, ""
+    while True:
+        attempt += 1
+        platform, detail = probe_default_backend(probe_timeout_s)
+        if platform is not None:
+            log(f"backend probe: {platform} (attempt {attempt})")
+            return
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        pause = min(30.0, remaining)
+        log(f"probe attempt {attempt} failed ({detail}) — "
+            f"retrying in {pause:.0f}s ({remaining:.0f}s budget left)")
+        time.sleep(pause)
     pin_cpu()
-    log(f"backend probe failed ({detail}) — "
+    log(f"backend probe failed after {attempt} attempts ({detail}) — "
         "falling back to CPU — numbers are NOT TPU numbers")
+
+
+def last_live_result() -> dict | None:
+    """Most recent COMMITTED hardware result from benchmarks/results/
+    (written by tools/tpu_battery.sh on a live tunnel window): the
+    driver's artifact then carries a trustworthy TPU number even when
+    this run's probe window found the tunnel dead."""
+    import os
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "results")
+    if not os.path.isdir(root):
+        return None
+    candidates = []
+    for kind in sorted(os.listdir(root)):
+        kdir = os.path.join(root, kind)
+        # no directory-name filter beyond isdir: the per-record
+        # platform=="tpu" check below decides — a battery whose
+        # device-kind probe failed (tunnel died late) lands in
+        # "unknown/" yet still holds genuine TPU records
+        if not os.path.isdir(kdir):
+            continue
+        for stamp in sorted(os.listdir(kdir)):
+            f = os.path.join(kdir, stamp, "bench.out")
+            if os.path.isfile(f):
+                candidates.append((stamp, kind, f))
+    for stamp, kind, f in sorted(candidates, reverse=True):
+        try:
+            with open(f) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if line.startswith("{"):
+                        rec = json.loads(line)
+                        if rec.get("platform") == "tpu":
+                            rec["captured"] = f"{kind}/{stamp}"
+                            return rec
+        except Exception:
+            continue
+    return None
 
 
 BATCH = 128
@@ -193,6 +248,13 @@ def main():
         "vs_baseline": round(value / baseline, 2) if baseline else None,
         **extras,
     }
+    if result.get("platform") != "tpu":
+        live = last_live_result()
+        if live is not None:
+            # clearly-labeled committed hardware number alongside the
+            # CPU fallback, so the driver artifact is never TPU-less
+            # just because the tunnel flapped during this probe window
+            result["last_live"] = live
     print(json.dumps(result))
 
 

@@ -91,7 +91,7 @@ def test_fsdp_lm_checkpoint_and_generate(mesh, windows, tmp_path):
     assert jax.tree.leaves(a.params)[0].shape[0] == 4  # row-sharded
 
     b = _trainer(mesh, fsdp=True)
-    assert b.restore(tmp_path / "lm_ckpt_1.npz") == 2
+    assert b.restore(tmp_path / "lm_ckpt_1") == 2
     h_a = a.fit(windows, epochs=3, start_epoch=2)
     h_b = b.fit(windows, epochs=3, start_epoch=2)
     assert h_a[0].mean_loss == pytest.approx(h_b[0].mean_loss, abs=0.0)

@@ -201,3 +201,74 @@ def test_async_sharded_matches_sync(tmp_path, cpu_devices):
         assert (tmp_path / "sync" / rel).read_bytes() == (
             tmp_path / "async" / rel
         ).read_bytes()
+
+
+def test_resave_is_crash_atomic(tmp_path, cpu_devices):
+    """Re-saving to an existing path must never let the new meta point at
+    old-step blobs: filenames are step-scoped, and stale blobs are GC'd
+    once the new meta is published (ADVICE r2)."""
+    mesh = _mesh(cpu_devices)
+    tree = _tree(mesh)
+    path = tmp_path / "ck"
+    checkpoint.save_sharded(path, tree, step=1)
+    old_files = sorted(f.name for d in path.glob("leaf_*") for f in d.glob("*.npz"))
+    assert all(f.startswith("s1_") for f in old_files)
+
+    tree2 = dict(tree, w=tree["w"] + 100.0)
+    checkpoint.save_sharded(path, tree2, step=2)
+    new_files = sorted(f.name for d in path.glob("leaf_*") for f in d.glob("*.npz"))
+    # every old-step blob is gone; meta references only existing files
+    assert all(f.startswith("s2_") for f in new_files)
+    meta = json.loads((path / "meta.json").read_text())
+    for i, rec in enumerate(meta["leaves"]):
+        for shard in rec["shards"]:
+            assert (path / f"leaf_{i}" / shard["file"]).exists()
+    out, step = checkpoint.restore_sharded(path, tree2)
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree2["w"]))
+
+
+def test_fsdp_gather_compiled_is_cached(cpu_devices):
+    """Repeated compiled gathers reuse one jitted program (ADVICE r2:
+    a fresh jit per call re-traced every time)."""
+    from tpu_dist.parallel import fsdp as fsdp_mod
+
+    mesh = _mesh(cpu_devices)
+    full = {"w": jnp.arange(48, dtype=jnp.float32).reshape(6, 8)}
+    sharded = parallel.fsdp_shard_params(full, mesh)
+    fsdp_mod._GATHER_CACHE.clear()
+    out1 = parallel.fsdp_gather_params_compiled(sharded, full, mesh, "data")
+    assert len(fsdp_mod._GATHER_CACHE) == 1
+    out2 = parallel.fsdp_gather_params_compiled(sharded, full, mesh, "data")
+    assert len(fsdp_mod._GATHER_CACHE) == 1  # hit, not a second entry
+    np.testing.assert_array_equal(np.asarray(out1["w"]), np.asarray(full["w"]))
+    np.testing.assert_array_equal(np.asarray(out2["w"]), np.asarray(full["w"]))
+
+
+def test_same_step_resave_crash_is_loud(tmp_path, cpu_devices, monkeypatch):
+    """Re-saving the SAME step reuses filenames, so a crash mid-overwrite
+    cannot be made atomic — instead meta.json is retracted first, turning
+    a silently-mixed checkpoint into a loud restore failure."""
+    mesh = _mesh(cpu_devices)
+    tree = _tree(mesh)
+    path = tmp_path / "ck"
+    checkpoint.save_sharded(path, tree, step=5)
+    assert (path / "meta.json").exists()
+
+    calls = {"n": 0}
+    real_savez = np.savez
+
+    def crashing_savez(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise OSError("simulated crash mid-save")
+        return real_savez(*a, **kw)
+
+    monkeypatch.setattr(np, "savez", crashing_savez)
+    with pytest.raises(OSError, match="simulated crash"):
+        checkpoint.save_sharded(path, dict(tree, w=tree["w"] + 1), step=5)
+    monkeypatch.setattr(np, "savez", real_savez)
+    # loud: no meta -> restore raises instead of mixing old/new blobs
+    assert not (path / "meta.json").exists()
+    with pytest.raises(Exception):
+        checkpoint.restore_sharded(path, tree)

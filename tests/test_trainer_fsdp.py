@@ -68,7 +68,7 @@ def test_fsdp_trainer_checkpoint_resume(tmp_path, mesh):
     t2 = train.Trainer(
         models.mnist_net(), models.IN_SHAPE, mesh, cfg
     )
-    assert t2.restore(tmp_path / "ckpt_0.npz") == 1
+    assert t2.restore(tmp_path / "ckpt_0") == 1
     t2.fit(ds, epochs=2, start_epoch=1)
     jax.tree.map(
         lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),

@@ -178,3 +178,31 @@ def test_masked_lm_loss_on_padded_batch_matches_trimmed(lm, lm_params):
     # unmasked loss on the padded batch would differ (sanity)
     bad = float(models.lm_loss(plogits, padded))
     assert abs(bad - expect) > 1e-3
+
+
+def test_remat_matches_dense(lm, lm_params):
+    """remat=True is a pure memory/compute trade: identical forward
+    values and gradients (jax.checkpoint recomputes, never changes
+    math)."""
+    import jax.numpy as jnp
+
+    from tpu_dist.models.transformer_lm import lm_loss
+
+    lm_r = models.TransformerLM(
+        vocab=64, dim=32, depth=2, heads=2, max_seq=32, remat=True
+    )
+    tokens = models.synthetic_tokens(2, 16, 64)
+    dense, _ = lm.apply(lm_params, {}, tokens)
+    remat, _ = lm_r.apply(lm_params, {}, tokens)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(remat), atol=1e-6)
+
+    def loss_d(p):
+        return lm_loss(lm.apply(p, {}, tokens)[0], tokens)
+
+    def loss_r(p):
+        return lm_loss(lm_r.apply(p, {}, tokens)[0], tokens)
+
+    gd = jax.grad(loss_d)(lm_params)
+    gr = jax.grad(loss_r)(lm_params)
+    for a, b in zip(jax.tree.leaves(gd), jax.tree.leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# The full hardware benchmark battery.  Run on a LIVE TPU tunnel (the
+# watcher probes compute-liveness first).  Each command logs to
+# $OUTDIR/<name>.{out,err}; JSON results are then copied into the repo
+# under benchmarks/results/<device_kind>/<UTC timestamp>/ so hardware
+# numbers live in git, not /tmp (VERDICT r2 weak #1/#2).
+#
+# Usage: tools/tpu_battery.sh <outdir>
+
+set -u
+cd "$(dirname "$0")/.."
+OUTDIR=${1:?usage: tpu_battery.sh <outdir>}
+mkdir -p "$OUTDIR"
+
+FAILED=0
+run() { # name timeout cmd...
+  local name=$1 to=$2 rc; shift 2
+  echo "[$(date +%T)] running $name" | tee -a "$OUTDIR/battery.log"
+  timeout "$to" "$@" >"$OUTDIR/$name.out" 2>"$OUTDIR/$name.err"
+  rc=$?
+  [ "$rc" -ne 0 ] && FAILED=$((FAILED + 1))
+  echo "[$(date +%T)] $name rc=$rc" | tee -a "$OUTDIR/battery.log"
+}
+
+# Headline parity bench + the compute-bound flagship first: if the tunnel
+# dies mid-battery, the most important numbers are already captured.
+run lm_train 2400 python benchmarks/lm_train.py
+run bench 1200 python bench.py
+run hwtests 1800 env TPU_DIST_TEST_TPU=1 python -m pytest tests/test_tpu_hardware.py -m tpu -q
+run kernels 2400 python benchmarks/kernels.py
+run decode 1800 python benchmarks/decode.py
+run scaling_mnist 1200 python benchmarks/scaling.py --max-world 1
+run scaling_vit 1800 python benchmarks/scaling.py --max-world 1 --model vit --batch-per-chip 32 --steps 10
+run allreduce 900 python demos/allreduce.py --world 1 --bench 20 --mbytes 64
+
+# Copy results into the repo (committed by the operator after review).
+KIND=$(timeout 60 python -c "import jax;print(jax.devices()[0].device_kind.replace(' ','_').replace('/','_'))" 2>/dev/null || echo unknown)
+STAMP=$(date -u +%Y%m%d_%H%M%S)
+DEST="benchmarks/results/${KIND}/${STAMP}"
+mkdir -p "$DEST"
+for f in "$OUTDIR"/*.out "$OUTDIR"/*.err "$OUTDIR"/battery.log; do
+  [ -s "$f" ] && cp "$f" "$DEST/" 2>/dev/null
+done
+echo "[$(date +%T)] battery done ($FAILED failed) -> $OUTDIR and $DEST" | tee -a "$OUTDIR/battery.log"
+cp "$OUTDIR/battery.log" "$DEST/" 2>/dev/null || true
+[ "$FAILED" -eq 0 ] && exit 0
+exit 2

@@ -2,17 +2,19 @@
 # TPU-tunnel watcher: polls for *compute* liveness (device enumeration is
 # not enough — the tunnel has a half-alive mode where jax.devices()
 # answers but any compile/execute hangs), and on first recovery runs the
-# full hardware battery, logging everything under $OUTDIR.
+# full hardware battery (tools/tpu_battery.sh), which copies JSON results
+# into benchmarks/results/ in the repo.  Exits after one battery so a
+# supervisor can commit results and relaunch.
 #
 # Usage: tools/tpu_watch.sh [outdir] [poll_seconds] [max_polls]
 # Exits 0 after a fully-green battery, 2 if the battery ran but some
-# command failed, 1 if the tunnel never recovered.
+# command failed, 1 if the tunnel never recovered within max_polls.
 
 set -u
 cd "$(dirname "$0")/.."
 OUTDIR=${1:-/tmp/tpu_runs/$(date +%Y%m%d_%H%M%S)}
-POLL=${2:-300}
-MAX=${3:-130}
+POLL=${2:-90}
+MAX=${3:-400}
 mkdir -p "$OUTDIR"
 
 probe() {
@@ -24,29 +26,11 @@ ok = ok and abs(float(np.asarray((x @ x).astype(jnp.float32))[0, 0]) - 128.0) < 
 sys.exit(0 if ok else 1)" >/dev/null 2>&1
 }
 
-FAILED=0
-run() { # name timeout cmd...
-  local name=$1 to=$2 rc; shift 2
-  echo "[$(date +%T)] running $name" | tee -a "$OUTDIR/watch.log"
-  timeout "$to" "$@" >"$OUTDIR/$name.out" 2>"$OUTDIR/$name.err"
-  rc=$?
-  [ "$rc" -ne 0 ] && FAILED=$((FAILED + 1))
-  echo "[$(date +%T)] $name rc=$rc" | tee -a "$OUTDIR/watch.log"
-}
-
 for i in $(seq 1 "$MAX"); do
   if probe; then
     echo "[$(date +%T)] poll $i: TPU compute LIVE — running battery" | tee -a "$OUTDIR/watch.log"
-    run bench 1200 python bench.py
-    run hwtests 1800 env TPU_DIST_TEST_TPU=1 python -m pytest tests/test_tpu_hardware.py -m tpu -q
-    run kernels 1800 python benchmarks/kernels.py
-    run scaling_mnist 1200 python benchmarks/scaling.py --max-world 1
-    run scaling_vit 1800 python benchmarks/scaling.py --max-world 1 --model vit --batch-per-chip 32 --steps 10
-    run allreduce 900 python demos/allreduce.py --world 1 --bench 20 --mbytes 64
-    run decode 1200 python benchmarks/decode.py
-    echo "[$(date +%T)] battery done ($FAILED failed) -> $OUTDIR" | tee -a "$OUTDIR/watch.log"
-    [ "$FAILED" -eq 0 ] && exit 0
-    exit 2
+    bash tools/tpu_battery.sh "$OUTDIR"
+    exit $?
   fi
   echo "[$(date +%T)] poll $i: tunnel dead" >> "$OUTDIR/watch.log"
   sleep "$POLL"

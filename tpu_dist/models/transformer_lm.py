@@ -37,12 +37,18 @@ class TransformerLM(Module):
         max_seq: int = 1024,
         kv_heads: int | None = None,
         pos_embedding: str = "learned",
+        remat: bool = False,
     ):
         if pos_embedding not in ("learned", "rope"):
             raise ValueError(
                 f"pos_embedding must be 'learned' or 'rope', got "
                 f"{pos_embedding!r}"
             )
+        # Rematerialize each block's forward during backward
+        # (jax.checkpoint): activation HBM drops from O(depth · B·S·d)
+        # to O(B·S·d) + one extra forward of FLOPs — the standard TPU
+        # memory/compute trade for long sequences or big batches.
+        self.remat = remat
         self.vocab = vocab
         self.dim = dim
         self.heads = heads
@@ -97,7 +103,13 @@ class TransformerLM(Module):
         batches)."""
         h = self._trunk(params, tokens)
         for blk, pb in zip(self.blocks, params["blocks"]):
-            h, _ = blk.apply(pb, {}, h, train=train, mask=attn_mask)
+            if self.remat:
+                def block_fn(pb_, h_, blk=blk):
+                    return blk.apply(pb_, {}, h_, train=train,
+                                     mask=attn_mask)[0]
+                h = jax.checkpoint(block_fn)(pb, h)
+            else:
+                h, _ = blk.apply(pb, {}, h, train=train, mask=attn_mask)
         h, _ = self.ln.apply(params["ln"], {}, h)
         logits = h @ params["embed"]["table"].T
         return logits, state

@@ -29,6 +29,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -123,6 +124,9 @@ def fsdp_gather_params(sharded: Any, template: Any) -> Any:
     )
 
 
+_GATHER_CACHE: dict = {}
+
+
 def fsdp_gather_params_compiled(
     sharded: Any, template: Any, mesh: Mesh, axis_name: str = DATA_AXIS
 ) -> Any:
@@ -131,24 +135,44 @@ def fsdp_gather_params_compiled(
     bytes to one host and raises when shards live on another process's
     devices).  Each (n, k) leaf all-gathers its rows over ``axis_name``
     and reshapes to the template's shape; the output is replicated, so
-    every process holds (and can read) the full tree."""
-    tmpl_struct = jax.tree.map(
-        lambda t: jax.ShapeDtypeStruct(tuple(t.shape), t.dtype), template
-    )
+    every process holds (and can read) the full tree.
 
-    mapped = jax.shard_map(
-        lambda local: _unshard_rows(local, tmpl_struct, axis_name),
-        mesh=mesh,
-        in_specs=(
-            jax.tree.map(
-                lambda leaf: P(axis_name) if jnp.ndim(leaf) >= 1 else P(),
-                sharded,
-            ),
-        ),
-        out_specs=P(),
-        check_vma=False,
+    The jitted gather is cached per (mesh, axis, tree structure/shapes),
+    so repeated eval/perplexity/generate calls hit one compilation
+    instead of re-tracing a fresh lambda every time."""
+    in_treedef = jax.tree.structure(sharded)
+    in_shapes = tuple(
+        (tuple(leaf.shape), np.dtype(leaf.dtype).str)
+        for leaf in jax.tree.leaves(sharded)
     )
-    return jax.jit(mapped)(sharded)
+    out_shapes = tuple(
+        (tuple(t.shape), np.dtype(t.dtype).str)
+        for t in jax.tree.leaves(template)
+    )
+    cache_key = (mesh, axis_name, in_treedef, in_shapes,
+                 jax.tree.structure(template), out_shapes)
+    fn = _GATHER_CACHE.get(cache_key)
+    if fn is None:
+        tmpl_struct = jax.tree.map(
+            lambda t: jax.ShapeDtypeStruct(tuple(t.shape), t.dtype), template
+        )
+        mapped = jax.shard_map(
+            lambda local: _unshard_rows(local, tmpl_struct, axis_name),
+            mesh=mesh,
+            in_specs=(
+                jax.tree.map(
+                    lambda leaf: P(axis_name) if jnp.ndim(leaf) >= 1 else P(),
+                    sharded,
+                ),
+            ),
+            out_specs=P(),
+            check_vma=False,
+        )
+        fn = jax.jit(mapped)
+        if len(_GATHER_CACHE) >= 8:  # bound: keys pin meshes/executables
+            _GATHER_CACHE.pop(next(iter(_GATHER_CACHE)))
+        _GATHER_CACHE[cache_key] = fn
+    return fn(sharded)
 
 
 def make_fsdp_train_step(

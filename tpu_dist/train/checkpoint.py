@@ -14,6 +14,7 @@ plain numpy.
 from __future__ import annotations
 
 import json
+import time
 from pathlib import Path
 from typing import Any
 
@@ -115,7 +116,7 @@ class AsyncCheckpointer:
         (its own shards) — the single-writer gate does not apply."""
         self.wait()
         p = Path(path)
-        meta_leaves, blobs = _plan_sharded_save(tree)
+        meta_leaves, blobs = _plan_sharded_save(tree, step)
         meta = {"step": step, "leaves": meta_leaves}
 
         def _write():
@@ -167,11 +168,21 @@ def _norm_index(index: tuple, shape: tuple[int, ...]) -> tuple[tuple[int, int], 
     return tuple(out)
 
 
-def _shard_filename(starts: tuple[int, ...]) -> str:
-    return "shard_" + "_".join(str(s) for s in starts) + ".npz" if starts else "shard_.npz"
+def _shard_filename(starts: tuple[int, ...], step: int = 0) -> str:
+    # The step prefix makes RE-saving a NEW step to an existing path
+    # crash-safe: the new meta.json only references s<newstep>_ files, so
+    # an interruption mid-save can never leave meta pointing at a mix of
+    # old- and new-step blobs (old files satisfy only the old meta).
+    # Filenames must be computable identically on EVERY process (each
+    # writes its own shards; process 0 writes the global meta), so the
+    # discriminator is the caller's step — nothing process-local.  The
+    # same-step-re-save case is handled in `_write_sharded` by
+    # retracting meta.json before overwriting (loud, not silent).
+    tail = "_".join(str(s) for s in starts) if starts else ""
+    return f"s{step}_shard_{tail}.npz"
 
 
-def _leaf_shard_table(leaf: Any) -> list[dict]:
+def _leaf_shard_table(leaf: Any, step: int = 0) -> list[dict]:
     """Global shard table for one leaf: every (offset, shape, file) in the
     leaf's sharding — known on EVERY process (shardings are global even
     when the data is not), so process 0 can record the full table."""
@@ -187,13 +198,15 @@ def _leaf_shard_table(leaf: Any) -> list[dict]:
             {
                 "offset": list(starts),
                 "shape": [b[1] - b[0] for b in bounds],
-                "file": _shard_filename(starts),
+                "file": _shard_filename(starts, step),
             }
         )
     return table
 
 
-def _plan_sharded_save(tree: Any) -> tuple[list[dict], list[tuple[str, tuple, bytes]]]:
+def _plan_sharded_save(
+    tree: Any, step: int = 0
+) -> tuple[list[dict], list[tuple[str, tuple, bytes]]]:
     """Split a sharded save into (meta, blobs-this-process-writes).
 
     The snapshot to host bytes happens HERE (synchronously), so callers
@@ -212,7 +225,7 @@ def _plan_sharded_save(tree: Any) -> tuple[list[dict], list[tuple[str, tuple, by
                 {
                     "offset": [0] * arr.ndim,
                     "shape": list(arr.shape),
-                    "file": _shard_filename((0,) * arr.ndim),
+                    "file": _shard_filename((0,) * arr.ndim, step),
                 }
             ]
             meta_leaves.append(
@@ -231,7 +244,7 @@ def _plan_sharded_save(tree: Any) -> tuple[list[dict], list[tuple[str, tuple, by
                 "path": keypath,
                 "shape": list(leaf.shape),
                 "dtype": np.dtype(leaf.dtype).name,
-                "shards": _leaf_shard_table(leaf),
+                "shards": _leaf_shard_table(leaf, step),
             }
         )
         for shard in leaf.addressable_shards:
@@ -245,14 +258,37 @@ def _plan_sharded_save(tree: Any) -> tuple[list[dict], list[tuple[str, tuple, by
             # corrupting the recorded shape for scalar leaves.
             data = np.asarray(shard.data)
             blobs.append(
-                (f"leaf_{i}/{_shard_filename(starts)}", data.shape, data.tobytes())
+                (
+                    f"leaf_{i}/{_shard_filename(starts, step)}",
+                    data.shape,
+                    data.tobytes(),
+                )
             )
     return meta_leaves, blobs
 
 
-def _write_sharded(path: Path, meta: dict, blobs: list[tuple[str, tuple, bytes]]) -> None:
+def _write_sharded(
+    path: Path,
+    meta: dict,
+    blobs: list[tuple[str, tuple, bytes]],
+    *,
+    publish_timeout_s: float = 120.0,
+) -> None:
     import jax
 
+    if jax.process_index() == 0:
+        # Re-saving the SAME step over an existing same-step checkpoint
+        # reuses the s<step>_ filenames, so a crash mid-overwrite could
+        # leave the old meta pointing at a mix of old and half-replaced
+        # blobs.  Retract meta.json first: the checkpoint is loudly
+        # in-progress (restore fails) instead of silently inconsistent.
+        old_meta = path / "meta.json"
+        if old_meta.exists():
+            try:
+                if json.loads(old_meta.read_text()).get("step") == meta["step"]:
+                    old_meta.unlink()
+            except (OSError, ValueError):
+                old_meta.unlink(missing_ok=True)
     for rel, shape, raw in blobs:
         f = path / rel
         f.parent.mkdir(parents=True, exist_ok=True)
@@ -267,9 +303,41 @@ def _write_sharded(path: Path, meta: dict, blobs: list[tuple[str, tuple, bytes]]
             )
         tmp.rename(f)
     if jax.process_index() == 0:
+        # Publish meta.json only once every shard file it references is
+        # visible (multi-host: other processes write their own blobs to
+        # the shared filesystem on their own schedule).  Polling — not a
+        # collective — so this is safe from the async writer thread.
+        referenced = [
+            path / f"leaf_{i}" / shard["file"]
+            for i, rec in enumerate(meta["leaves"])
+            for shard in rec["shards"]
+        ]
+        deadline = time.monotonic() + publish_timeout_s
+        missing = [f for f in referenced if not f.exists()]
+        while missing and time.monotonic() < deadline:
+            time.sleep(0.05)
+            missing = [f for f in missing if not f.exists()]
+        if missing:
+            raise RuntimeError(
+                f"sharded checkpoint {path}: {len(missing)} shard file(s) "
+                f"still missing after {publish_timeout_s:.0f}s (e.g. "
+                f"{missing[0]}) — not publishing meta.json over an "
+                "incomplete checkpoint"
+            )
         tmp = path / "meta.json.tmp"
         tmp.write_text(json.dumps(meta))
         tmp.rename(path / "meta.json")
+        # Best-effort GC of blobs no meta references anymore (earlier
+        # steps re-saved to the same path).  Files in the new meta were
+        # verified present above, so this only removes stale-step blobs.
+        keep = {str(f) for f in referenced}
+        for leaf_dir in path.glob("leaf_*"):
+            for f in leaf_dir.glob("*.npz"):
+                if str(f) not in keep:
+                    try:
+                        f.unlink()
+                    except OSError:
+                        pass
 
 
 def save_sharded(path: str | Path, tree: Any, *, step: int = 0) -> None:
@@ -287,7 +355,7 @@ def save_sharded(path: str | Path, tree: Any, *, step: int = 0) -> None:
     checkpoint back (e.g. the next collective, or a barrier)."""
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
-    meta_leaves, blobs = _plan_sharded_save(tree)
+    meta_leaves, blobs = _plan_sharded_save(tree, step)
     _write_sharded(path, {"step": step, "leaves": meta_leaves}, blobs)
 
 

@@ -219,8 +219,10 @@ class LMTrainer:
             if checkpoint_dir:
                 tree = {"params": self.params, "opt_state": self.opt_state}
                 if self._sharded_mode:
+                    # sharded format = a DIRECTORY of shard files — no
+                    # .npz suffix (ADVICE r2: a dir named .npz misleads)
                     writer.save_sharded(
-                        f"{checkpoint_dir}/lm_ckpt_{epoch}.npz", tree,
+                        f"{checkpoint_dir}/lm_ckpt_{epoch}", tree,
                         step=epoch + 1,
                     )
                 else:

@@ -96,7 +96,7 @@ class Trainer:
         # torch.manual_seed(1234) analog: all replicas share this init key.
         key = jax.random.key(self.config.seed)
         params, state = model.init(key, in_shape)
-        sharded_mode = self.config.fsdp or self.config.zero1
+        sharded_mode = self._sharded_mode
         if self.config.fsdp and self.config.zero1:
             raise ValueError("fsdp and zero1 are mutually exclusive")
         if sharded_mode and jax.tree.leaves(state):
@@ -195,6 +195,12 @@ class Trainer:
             lambda params, state, x: model.apply(params, state, x, train=False)[0]
         )
 
+    @property
+    def _sharded_mode(self) -> bool:
+        """Single owner of the sharded-vs-replicated format dispatch —
+        save/restore/fit must all agree on it."""
+        return self.config.fsdp or self.config.zero1
+
     def save(self, path, *, epoch: int = 0, async_writer=None) -> None:
         """Checkpoint the full training state (params, model state,
         optimizer) — single writer, replicas identical (SURVEY.md §5).
@@ -202,7 +208,7 @@ class Trainer:
         file write overlaps subsequent training steps."""
         from tpu_dist.train import checkpoint
 
-        if self.config.fsdp or self.config.zero1:
+        if self._sharded_mode:
             # Sharded state: per-shard files, no global array materialized
             # (``path`` becomes a directory — see checkpoint.save_sharded).
             tree = {"params": self.params, "opt_state": self.opt_state}
@@ -226,7 +232,7 @@ class Trainer:
         (resume point)."""
         from tpu_dist.train import checkpoint
 
-        if self.config.fsdp or self.config.zero1:
+        if self._sharded_mode:
             like = {"params": self.params, "opt_state": self.opt_state}
             restored, epoch = checkpoint.restore_fsdp(path, like)
             self.params = restored["params"]
@@ -256,7 +262,9 @@ class Trainer:
         """Run the training loop.
 
         ``start_epoch`` resumes mid-schedule (pair with `restore`);
-        ``checkpoint_dir`` writes ``ckpt_<epoch>.npz`` after each epoch —
+        ``checkpoint_dir`` writes ``ckpt_<epoch>.npz`` after each epoch
+        (fsdp/zero1 state uses the sharded DIRECTORY format, named
+        ``ckpt_<epoch>`` — no misleading .npz suffix on a directory) —
         asynchronously: the device→host snapshot is taken inline but the
         file write overlaps the next epoch's steps (joined before `fit`
         returns);
@@ -323,8 +331,9 @@ class Trainer:
             )
             history.append(EpochStats(epoch, mean_loss, dt, sps, acc))
             if checkpoint_dir is not None:
+                suffix = "" if self._sharded_mode else ".npz"
                 self.save(
-                    f"{checkpoint_dir}/ckpt_{epoch}.npz", epoch=epoch + 1,
+                    f"{checkpoint_dir}/ckpt_{epoch}{suffix}", epoch=epoch + 1,
                     async_writer=ckpt_writer,
                 )
         if ckpt_writer is not None:
