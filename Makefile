@@ -7,7 +7,7 @@ PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
 .PHONY: test ptp gather allreduce train bench runtime train-image \
-        kernels decode serve \
+        kernels decode serve lm-train parity figures \
         scaling multiproc longcontext train-lm generate docs demos
 
 test:
@@ -55,7 +55,17 @@ kernels:
 decode:
 	$(PY) benchmarks/decode.py --platform $(PLATFORM)
 
+lm-train:
+	$(PY) benchmarks/lm_train.py --platform $(PLATFORM)
+
+parity:
+	$(PY) tools/parity_real_data.py --platform $(PLATFORM)
+
+figures:
+	$(PY) tools/gen_figures.py
+
 docs:
+	$(PY) tools/gen_figures.py
 	$(PY) tools/render_docs.py
 
 # All four reference-parity demos in sequence (the reference's scripts,

@@ -65,11 +65,12 @@ def ensure_live_backend(
         "falling back to CPU — numbers are NOT TPU numbers")
 
 
-def last_live_result() -> dict | None:
+def last_live_result(out_name: str = "bench.out") -> dict | None:
     """Most recent COMMITTED hardware result from benchmarks/results/
     (written by tools/tpu_battery.sh on a live tunnel window): the
     driver's artifact then carries a trustworthy TPU number even when
-    this run's probe window found the tunnel dead."""
+    this run's probe window found the tunnel dead.  ``out_name`` selects
+    which battery log to read (bench.out, lm_train.out, ...)."""
     import os
 
     root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -86,7 +87,7 @@ def last_live_result() -> dict | None:
         if not os.path.isdir(kdir):
             continue
         for stamp in sorted(os.listdir(kdir)):
-            f = os.path.join(kdir, stamp, "bench.out")
+            f = os.path.join(kdir, stamp, out_name)
             if os.path.isfile(f):
                 candidates.append((stamp, kind, f))
     for stamp, kind, f in sorted(candidates, reverse=True):
@@ -255,6 +256,14 @@ def main():
             # CPU fallback, so the driver artifact is never TPU-less
             # just because the tunnel flapped during this probe window
             result["last_live"] = live
+        lm = last_live_result("lm_train.out")
+        if lm is not None:
+            # the compute-bound flagship (MFU) from the same committed
+            # battery results, for the same reason
+            result["last_live_lm"] = {
+                k: lm.get(k)
+                for k in ("metric", "value", "unit", "best", "captured")
+            }
     print(json.dumps(result))
 
 

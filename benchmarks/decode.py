@@ -46,6 +46,10 @@ def main():
 
     from tpu_dist import models
 
+    import numpy as np
+
+    from tpu_dist.train.flops import hbm_bandwidth
+
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} ({dev.device_kind})", file=sys.stderr)
     lm = models.TransformerLM(
@@ -53,6 +57,10 @@ def main():
         heads=args.heads, max_seq=args.max_seq,
     )
     params, _ = lm.init(jax.random.key(0))
+    param_bytes = sum(
+        a.size * a.dtype.itemsize for a in jax.tree.leaves(params)
+    )
+    bw = hbm_bandwidth(dev)
     rows = []
     for b in args.batches:
         prompt = jax.random.randint(
@@ -70,14 +78,45 @@ def main():
             host_sync(out)  # element readback: see host_sync doc
             dt = min(dt, time.perf_counter() - t0)
         toks = b * args.steps
-        rows.append({
+        row = {
             "batch": b,
             "tokens_per_sec": round(toks / dt, 1),
             "ms_per_token_step": round(dt / args.steps * 1e3, 3),
-        })
+        }
+        if bw is not None:
+            # HBM roofline (mirror of the MFU>100% guard): every decode
+            # step must at minimum re-read the weights plus this batch's
+            # live KV cache, so tok/s cannot exceed b · BW / bytes_step.
+            # KV bytes use the MEAN live cache length over the run (the
+            # cache fills as it decodes) — a lower bound on traffic,
+            # hence an upper bound on credible tok/s.
+            cache = lm.init_cache(b, args.max_seq)
+            kv_full = sum(
+                a.size * a.dtype.itemsize for a in jax.tree.leaves(cache)
+            )
+            mean_len = args.prompt + args.steps / 2
+            kv_bytes = kv_full * mean_len / args.max_seq
+            bytes_step = param_bytes + kv_bytes
+            ceiling = b * bw / bytes_step
+            row["roofline_tokens_per_sec"] = round(ceiling, 1)
+            if row["tokens_per_sec"] > ceiling:
+                row["suspect"] = True
+                print(
+                    f"batch {b}: REJECTED {toks / dt:,.0f} tok/s exceeds "
+                    f"the HBM roofline {ceiling:,.0f} (bytes/step "
+                    f"{bytes_step / 1e6:.1f} MB @ {bw / 1e9:.0f} GB/s) — "
+                    "timing untrustworthy",
+                    file=sys.stderr,
+                )
+        rows.append(row)
         print(
             f"batch {b:4d}: {toks / dt:10,.0f} tok/s  "
-            f"({dt / args.steps * 1e3:.2f} ms/step)",
+            f"({dt / args.steps * 1e3:.2f} ms/step)"
+            + (
+                f"  [roofline {row['roofline_tokens_per_sec']:,.0f}]"
+                if "roofline_tokens_per_sec" in row
+                else ""
+            ),
             file=sys.stderr,
         )
     print(json.dumps({

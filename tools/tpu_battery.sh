@@ -27,7 +27,7 @@ run() { # name timeout cmd...
 run lm_train 2400 python benchmarks/lm_train.py
 run bench 1200 python bench.py
 run hwtests 1800 env TPU_DIST_TEST_TPU=1 python -m pytest tests/test_tpu_hardware.py -m tpu -q
-run kernels 2400 python benchmarks/kernels.py
+run kernels 2400 python benchmarks/kernels.py --tune
 run decode 1800 python benchmarks/decode.py
 run scaling_mnist 1200 python benchmarks/scaling.py --max-world 1
 run scaling_vit 1800 python benchmarks/scaling.py --max-world 1 --model vit --batch-per-chip 32 --steps 10

@@ -37,6 +37,35 @@ _PEAK_BF16: dict[str, float] = {
 }
 
 
+# Public per-chip HBM bandwidth, bytes/s — the decode-side roofline
+# (autoregressive decode re-reads weights + KV cache every step, so
+# tok/s is bounded by bandwidth long before the MXU matters).
+_HBM_BW: dict[str, float] = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,  # v5e
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,  # v5p
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,  # v6e / Trillium
+    "TPU v6e": 1640e9,
+}
+
+
+def hbm_bandwidth(device: Any | None = None) -> float | None:
+    """Per-chip HBM bandwidth (bytes/s); None when unknown (CPU-sim)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "") or ""
+    for name, bw in _HBM_BW.items():
+        if kind.lower().startswith(name.lower()):
+            return bw
+    return None
+
+
 def peak_flops(device: Any | None = None) -> float | None:
     """Per-chip bf16 peak FLOP/s for ``device`` (default: first device).
 
