@@ -92,3 +92,23 @@ def test_device_memory_stats_shape():
     stats = metrics.device_memory_stats()
     # CPU-sim backends report nothing; a real chip reports a dict.
     assert stats is None or "bytes_in_use" in stats
+
+
+def test_peak_tables_use_longest_prefix_match():
+    """ADVICE r3: 'TPU v5 lite' must win over 'TPU v5' for a v5e part
+    regardless of dict insertion order."""
+    from tpu_dist.train import flops
+
+    class FakeDev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    assert flops.peak_flops(FakeDev("TPU v5 lite")) == 197e12
+    assert flops.peak_flops(FakeDev("TPU v5")) == 459e12
+    assert flops.hbm_bandwidth(FakeDev("TPU v5 lite")) == 819e9
+    assert flops.hbm_bandwidth(FakeDev("TPU v5p")) == 2765e9
+    # order-independence: a reversed table gives the same answers
+    reversed_table = dict(reversed(list(flops._PEAK_BF16.items())))
+    assert flops._longest_prefix_match(reversed_table, "TPU v5 lite") == 197e12
+    assert flops._longest_prefix_match(reversed_table, "TPU v5") == 459e12
+    assert flops._longest_prefix_match(reversed_table, "Unknown chip") is None

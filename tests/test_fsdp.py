@@ -248,3 +248,29 @@ def test_zero1_layout(cpu_devices):
     p2, o2, loss, _ = step(p_z, o_z, sb, jax.random.key(0))
     assert np.isfinite(float(loss))
     assert jax.tree.leaves(p2)[0].shape == jax.tree.leaves(params)[0].shape
+
+
+def test_gather_cache_evicts_lru_not_fifo(cpu_devices):
+    """ADVICE r3: with >8 distinct keys cycling, FIFO eviction would evict
+    the entry about to be reused; LRU keeps recently-hit entries alive."""
+    from jax.sharding import Mesh
+
+    from tpu_dist.parallel import fsdp as fsdp_mod
+
+    mesh = Mesh(np.array(cpu_devices[:8]), ("data",))
+    fsdp_mod._GATHER_CACHE.clear()
+    trees = []
+    for i in range(8):
+        full = {"w": jnp.ones((8, 8 + i), jnp.float32)}
+        trees.append((parallel.fsdp_shard_params(full, mesh), full))
+        parallel.fsdp_gather_params_compiled(*trees[-1], mesh, "data")
+    assert len(fsdp_mod._GATHER_CACHE) == 8
+    hot_key = next(iter(fsdp_mod._GATHER_CACHE))  # oldest-inserted
+    # hit the oldest entry -> under LRU it becomes most-recent
+    parallel.fsdp_gather_params_compiled(*trees[0], mesh, "data")
+    full9 = {"w": jnp.ones((8, 99), jnp.float32)}
+    parallel.fsdp_gather_params_compiled(
+        parallel.fsdp_shard_params(full9, mesh), full9, mesh, "data"
+    )
+    assert len(fsdp_mod._GATHER_CACHE) == 8
+    assert hot_key in fsdp_mod._GATHER_CACHE  # survived: not FIFO

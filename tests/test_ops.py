@@ -179,3 +179,97 @@ class TestPallasRing:
         expect = np.stack([np.arange(8.0) + r for r in range(4)]).sum(0)
         for r in range(4):
             np.testing.assert_allclose(out[r], expect)
+
+
+class TestMatmulBlockSelection:
+    def test_nondivisible_shapes_are_padded_and_correct(self):
+        """ADVICE r3: shapes nothing >=128 divides used to fall back to a
+        FULL-dimension block (VMEM-busting for large dims).  They are now
+        padded to 128-multiples; results must still match XLA exactly."""
+        from tpu_dist.ops.matmul import matmul
+
+        x = jax.random.normal(jax.random.key(0), (520, 384))
+        w = jax.random.normal(jax.random.key(1), (384, 520))
+        b = jax.random.normal(jax.random.key(2), (520,))
+        out = matmul(x, w, b, epilogue="relu", interpret=True)
+        ref = jax.nn.relu(x @ w + b)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_auto_blocks_respect_vmem_budget(self):
+        """The fallback path applies the same VMEM bound as the main loop
+        (ADVICE r3: it used to skip the check entirely)."""
+        import importlib
+
+        mm = importlib.import_module("tpu_dist.ops.matmul")
+
+        for shape in [(512, 512, 512), (3072, 3072, 3072), (640, 640, 8192),
+                      (128, 4096, 2048)]:
+            bm, bn, bk = mm._auto_blocks(*shape)
+            assert mm._vmem_bytes(bm, bn, bk) <= mm._VMEM_BUDGET, shape
+
+    def test_grad_through_padded_shapes(self):
+        from tpu_dist.ops.matmul import matmul
+
+        x = jax.random.normal(jax.random.key(3), (260, 384))
+        w = jax.random.normal(jax.random.key(4), (384, 260))
+
+        def loss(x, w):
+            return matmul(x, w, epilogue="gelu", interpret=True).sum()
+
+        def loss_ref(x, w):
+            return jax.nn.gelu(x @ w).sum()
+
+        gk = jax.grad(loss, argnums=(0, 1))(x, w)
+        gr = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+        for a, b_ in zip(gk, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4
+            )
+
+
+def test_explicit_divisible_block_suppresses_padding():
+    """An explicit block that divides the dim must be honored — padding
+    to a 128-multiple would orphan it (e.g. bm=500 divides m=3000 but
+    nothing divides 3072) and degenerate to a full-dim block."""
+    import importlib
+
+    mm = importlib.import_module("tpu_dist.ops.matmul")
+    # the pad decision is per-dim against the requested block
+    x = jax.random.normal(jax.random.key(20), (600, 256))
+    w = jax.random.normal(jax.random.key(21), (256, 256))
+    out = mm.matmul(x, w, bm=300, interpret=True)  # 300 | 600: no pad
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x @ w), rtol=2e-5, atol=2e-5
+    )
+    # and the auto path still pads 600 (no power-of-two >=128 divides it)
+    assert mm._pick_block(600, 512) == 600
+    out_auto = mm.matmul(x, w, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out_auto), np.asarray(x @ w), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_explicit_nondividing_block_skips_useless_padding():
+    """Padding is only applied when it buys a dividing block: an explicit
+    block that divides neither the dim nor its 128-multiple must not pay
+    the pad copy (it would degenerate to a full-dim block either way)."""
+    import importlib
+
+    mm = importlib.import_module("tpu_dist.ops.matmul")
+    x = jax.random.normal(jax.random.key(22), (600, 256))
+    w = jax.random.normal(jax.random.key(23), (256, 128))
+    # 500 divides neither 600 nor 640 -> no pad, single 600-row block
+    out = mm.matmul(x, w, bm=500, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x @ w), rtol=2e-5, atol=2e-5
+    )
+    # auto path: padding 600->640 buys 128-blocks, so it pads
+    jaxpr = str(jax.make_jaxpr(lambda a, b: mm.matmul(a, b, interpret=True))(x, w))
+    assert "pad" in jaxpr
+    jaxpr_explicit = str(
+        jax.make_jaxpr(lambda a, b: mm.matmul(a, b, bm=500, interpret=True))(x, w)
+    )
+    assert "pad" not in jaxpr_explicit

@@ -7,6 +7,7 @@ FSDP/TP-state path, where no host ever holds the global array.
 """
 
 import json
+import time
 from pathlib import Path
 
 import jax
@@ -272,3 +273,175 @@ def test_same_step_resave_crash_is_loud(tmp_path, cpu_devices, monkeypatch):
     assert not (path / "meta.json").exists()
     with pytest.raises(Exception):
         checkpoint.restore_sharded(path, tree)
+
+
+def test_nonzero_process_waits_for_retraction(tmp_path, cpu_devices, monkeypatch):
+    """ADVICE r3 (medium): in a multi-host same-step re-save, a non-zero
+    process must NOT overwrite s<step>_ blobs while the old same-step
+    meta.json still references them — it waits for process 0's retraction
+    (marker present + same-step meta gone) and fails loudly on timeout,
+    leaving the live checkpoint intact."""
+    mesh = _mesh(cpu_devices)
+    tree = _tree(mesh)
+    path = tmp_path / "ck"
+    checkpoint.save_sharded(path, tree, step=5)
+    before = {
+        f: (path / f).read_bytes()
+        for d in path.glob("leaf_*")
+        for f in [str(Path(d.name) / b.name) for b in d.glob("*.npz")]
+    }
+    assert before and (path / "meta.json").exists()
+
+    meta, blobs = checkpoint._plan_sharded_save(tree, step=5)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    with pytest.raises(RuntimeError, match="did not retract"):
+        checkpoint._write_sharded(
+            path, {"step": 5, "leaves": meta}, blobs, publish_timeout_s=0.3
+        )
+    # nothing overwritten, checkpoint still restorable
+    for f, raw in before.items():
+        assert (path / f).read_bytes() == raw
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    out, step = checkpoint.restore_sharded(path, tree)
+    assert step == 5
+
+
+def test_nonzero_process_proceeds_once_marker_is_up(
+    tmp_path, cpu_devices, monkeypatch
+):
+    """Once process 0 has retracted the same-step meta and published this
+    attempt's marker, non-zero processes write their blobs (and never
+    touch meta.json themselves)."""
+    mesh = _mesh(cpu_devices)
+    tree = _tree(mesh)
+    path = tmp_path / "ck"
+    path.mkdir()
+    (path / "save_inprogress.json").write_text(json.dumps({"step": 5}))
+
+    meta, blobs = checkpoint._plan_sharded_save(tree, step=5)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    import threading
+
+    def publish_when_blobs_land():
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if any(path.glob("leaf_*/*.npz")):
+                (path / "meta.json").write_text(json.dumps({"step": 5}))
+                return
+            time.sleep(0.02)
+
+    t = threading.Thread(target=publish_when_blobs_land)
+    t.start()
+    checkpoint._write_sharded(
+        path, {"step": 5, "leaves": meta}, blobs, publish_timeout_s=5.0
+    )
+    t.join()
+    assert any(path.glob("leaf_*/*.npz"))  # blobs landed before publish
+
+
+def test_publish_requires_fresh_blobs(tmp_path, cpu_devices, monkeypatch):
+    """Same-step re-saves reuse filenames, so the publish wait must not be
+    satisfied by a STALE blob left from the previous attempt: every
+    referenced file's mtime must reach this attempt's marker."""
+    import os
+
+    mesh = _mesh(cpu_devices)
+    tree = _tree(mesh)
+    path = tmp_path / "ck"
+    meta, blobs = checkpoint._plan_sharded_save(tree, step=5)
+    full_meta = {"step": 5, "leaves": meta}
+
+    # Simulate "another process's blob": drop one blob from OUR write
+    # list and pre-create its file with an old mtime (previous attempt).
+    dropped_rel, shape, raw = blobs[-1]
+    ours = blobs[:-1]
+    stale = path / dropped_rel
+    stale.parent.mkdir(parents=True)
+    stale.write_bytes(b"old attempt")
+    past = 1_000_000_000.0
+    os.utime(stale, (past, past))
+
+    with pytest.raises(RuntimeError, match="missing or stale"):
+        checkpoint._write_sharded(path, full_meta, ours, publish_timeout_s=0.5)
+    assert not (path / "meta.json").exists()
+
+    # The "other process" writes a fresh blob -> publish succeeds.
+    checkpoint._write_sharded(path, full_meta, blobs, publish_timeout_s=5.0)
+    assert json.loads((path / "meta.json").read_text())["step"] == 5
+    assert not (path / "save_inprogress.json").exists()  # marker cleaned up
+    out, step = checkpoint.restore_sharded(path, tree)
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+
+
+def test_nonzero_process_with_no_blobs_skips_gate(
+    tmp_path, cpu_devices, monkeypatch
+):
+    """A process that owns no primary shards has nothing to overwrite —
+    it must NOT wait on the retraction gate (process 0 may already have
+    published and removed the marker, which would read as a timeout)."""
+    mesh = _mesh(cpu_devices)
+    tree = _tree(mesh)
+    path = tmp_path / "ck"
+    checkpoint.save_sharded(path, tree, step=5)  # same-step meta present
+    meta, _ = checkpoint._plan_sharded_save(tree, step=5)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    # no marker on disk, same-step meta exists: with blobs this would
+    # block; with none it returns immediately and touches nothing
+    checkpoint._write_sharded(
+        path, {"step": 5, "leaves": meta}, [], publish_timeout_s=0.3
+    )
+    assert (path / "meta.json").exists()
+
+
+def test_stale_marker_retry_converges(tmp_path, cpu_devices, monkeypatch):
+    """A marker left by a CRASHED same-step attempt lets a non-zero
+    process write blobs BEFORE process 0 rewrites the marker; the blobs
+    then sit below the freshness bar.  The non-zero process must re-touch
+    them until process 0's publish succeeds — the retry converges instead
+    of timing out."""
+    import os
+    import threading
+
+    mesh = _mesh(cpu_devices)
+    tree = _tree(mesh)
+    path = tmp_path / "ck"
+    path.mkdir()
+    # crashed attempt: meta retracted, stale same-step marker left behind
+    marker = path / "save_inprogress.json"
+    marker.write_text(json.dumps({"step": 5}))
+    past = 1_000_000_000.0
+    os.utime(marker, (past, past))
+
+    meta, blobs = checkpoint._plan_sharded_save(tree, step=5)
+    full_meta = {"step": 5, "leaves": meta}
+    # split ownership: thread "p1" owns the last blob, process 0 the rest
+    p0_blobs, p1_blobs = blobs[:-1], blobs[-1:]
+
+    ids = {}
+    monkeypatch.setattr(
+        jax,
+        "process_index",
+        lambda: ids.get(threading.current_thread().name, 0),
+    )
+    errors = []
+
+    def run_p1():
+        try:
+            checkpoint._write_sharded(
+                path, full_meta, p1_blobs, publish_timeout_s=10.0
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced via `errors`
+            errors.append(e)
+
+    t = threading.Thread(target=run_p1, name="p1")
+    ids["p1"] = 1
+    t.start()
+    time.sleep(0.5)  # let p1 pass the gate via the stale marker and write
+    checkpoint._write_sharded(path, full_meta, p0_blobs, publish_timeout_s=10.0)
+    t.join(timeout=15.0)
+    assert not t.is_alive() and not errors, errors
+    assert json.loads((path / "meta.json").read_text())["step"] == 5
+    out, step = checkpoint.restore_sharded(path, tree)
+    assert step == 5
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))

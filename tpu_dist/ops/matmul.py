@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import os
+import warnings
 from typing import Callable
 
 import jax
@@ -54,6 +55,18 @@ def _matmul_kernel(x_ref, w_ref, b_ref, o_ref, acc_ref, *, epilogue: str, nk: in
         o_ref[:] = _EPILOGUES[epilogue](out).astype(o_ref.dtype)
 
 
+_VMEM_BUDGET = 8 * 1024 * 1024  # ~half of a core's ~16MB VMEM
+
+
+def _vmem_bytes(bm: int, bn: int, bk: int) -> int:
+    """Working set: 2 copies (double buffer) of the input blocks + the
+    f32 accumulator + the output block."""
+    x_b = bm * bk * 4
+    w_b = bk * bn * 4
+    acc_b = bm * bn * 4
+    return 2 * (x_b + w_b) + 2 * acc_b
+
+
 def _pick_block(dim: int, target: int) -> int:
     """Largest power-of-two block <= target that divides dim (falls back
     to the full dimension for sizes nothing divides — tiny/odd shapes
@@ -78,25 +91,70 @@ def _auto_blocks(m: int, n: int, k: int) -> tuple[int, int, int]:
     buffered + f32 acc(bm,bn) + out within ~half of VMEM."""
     bm = _pick_block(m, 512)
     bn = _pick_block(n, 512)
-    for bk_target in (2048, 1024, 512):
+    for bk_target in (2048, 1024, 512, 256, 128):
         bk = _pick_block(k, bk_target)
-        # bytes: 2 copies (double buffer) of the bf16/f32 input blocks
-        # + the f32 accumulator + the output block
-        x_b = bm * bk * 4
-        w_b = bk * bn * 4
-        acc_b = bm * bn * 4
-        if 2 * (x_b + w_b) + 2 * acc_b <= 8 * 1024 * 1024:
+        if _vmem_bytes(bm, bn, bk) <= _VMEM_BUDGET:
             return bm, bn, bk
-    return bm, bn, _pick_block(k, 512)
+    # Nothing fit: only reachable when _pick_block returned a full
+    # dimension (nothing >=128 divides it) and that block blows the
+    # budget.  Callers pad to 128-multiples before block selection, so
+    # this is a guard for explicit odd shapes: shrink the largest block
+    # until the working set fits (full-dim blocks cannot shrink — warn).
+    bk = _pick_block(k, 128)
+    if _vmem_bytes(bm, bn, bk) > _VMEM_BUDGET:
+        warnings.warn(
+            f"pallas matmul blocks ({bm},{bn},{bk}) for shape "
+            f"({m},{n},{k}) exceed the ~{_VMEM_BUDGET >> 20}MB VMEM "
+            "budget (no power-of-two >=128 divides the dimensions); "
+            "pass bm/bn/bk explicitly or pad the operands",
+            stacklevel=3,
+        )
+    return bm, bn, bk
 
 
 def _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret):
     m, k = x.shape
     _, n = w.shape
+    # Pad dims that no viable block divides up to the next 128-multiple
+    # (k-padding contributes zeros; m/n padding is sliced off) so block
+    # selection never degenerates to a full — possibly VMEM-busting —
+    # dimension.  A dim's viability is judged against the block the
+    # caller actually requested (an explicit bm=500 that divides m=3000
+    # must be honored, not padded away); shapes already served by one
+    # block (dim <= 256, the pad-unit x2) skip padding: a single small
+    # block is cheaper than a copy.
+    def _pad_amount(d: int, t: int | None) -> int:
+        if d <= 256 or _pick_block(d, t or 512) != d:
+            return 0  # a single small block, or a dividing block exists
+        padded = d + ((-d) % 128)
+        # Pad only when it buys a dividing block: an explicit block that
+        # divides neither d nor the 128-multiple (e.g. bm=3000, m=70000)
+        # would still degenerate to a full-dim block — after paying for
+        # the pad copy.
+        return padded - d if _pick_block(padded, t or 512) != padded else 0
+
+    pads = [_pad_amount(d, t) for d, t in zip((m, n, k), (bm, bn, bk))]
+    if any(pads):
+        pm, pn, pk = pads
+        x = jnp.pad(x, ((0, pm), (0, pk)))
+        w = jnp.pad(w, ((0, pk), (0, pn)))
+        b = jnp.pad(b, ((0, 0), (0, pn)))
+        out = _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret)
+        return out[:m, :n]
     if bm is None or bn is None or bk is None:
         abm, abn, abk = _auto_blocks(m, n, k)
         bm, bn, bk = bm or abm, bn or abn, bk or abk
     bm_, bn_, bk_ = _pick_block(m, bm), _pick_block(n, bn), _pick_block(k, bk)
+    if not interpret and _vmem_bytes(bm_, bn_, bk_) > _VMEM_BUDGET:
+        # explicit blocks bypass _auto_blocks' budget loop (and padding
+        # cannot rescue a block that divides nothing) — never silent
+        warnings.warn(
+            f"pallas matmul blocks ({bm_},{bn_},{bk_}) for shape "
+            f"({m},{n},{k}) exceed the ~{_VMEM_BUDGET >> 20}MB VMEM "
+            "budget; expect Mosaic failure or HBM spills — pass smaller "
+            "bm/bn/bk or pad the operands",
+            stacklevel=3,
+        )
     nk = k // bk_
     grid = (m // bm_, n // bn_, nk)
     kernel = functools.partial(_matmul_kernel, epilogue=epilogue, nk=nk)

@@ -24,6 +24,7 @@ stay exactly zero under SGD/momentum/AdamW.
 
 from __future__ import annotations
 
+import collections
 import math
 from typing import Any, Callable
 
@@ -124,7 +125,7 @@ def fsdp_gather_params(sharded: Any, template: Any) -> Any:
     )
 
 
-_GATHER_CACHE: dict = {}
+_GATHER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 
 
 def fsdp_gather_params_compiled(
@@ -152,7 +153,9 @@ def fsdp_gather_params_compiled(
     cache_key = (mesh, axis_name, in_treedef, in_shapes,
                  jax.tree.structure(template), out_shapes)
     fn = _GATHER_CACHE.get(cache_key)
-    if fn is None:
+    if fn is not None:
+        _GATHER_CACHE.move_to_end(cache_key)  # LRU: keep hot entries
+    else:
         tmpl_struct = jax.tree.map(
             lambda t: jax.ShapeDtypeStruct(tuple(t.shape), t.dtype), template
         )
@@ -170,7 +173,7 @@ def fsdp_gather_params_compiled(
         )
         fn = jax.jit(mapped)
         if len(_GATHER_CACHE) >= 8:  # bound: keys pin meshes/executables
-            _GATHER_CACHE.pop(next(iter(_GATHER_CACHE)))
+            _GATHER_CACHE.popitem(last=False)  # evict least-recently-used
         _GATHER_CACHE[cache_key] = fn
     return fn(sharded)
 

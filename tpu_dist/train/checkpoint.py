@@ -14,6 +14,7 @@ plain numpy.
 from __future__ import annotations
 
 import json
+import os
 import time
 from pathlib import Path
 from typing import Any
@@ -276,6 +277,7 @@ def _write_sharded(
 ) -> None:
     import jax
 
+    marker = path / "save_inprogress.json"
     if jax.process_index() == 0:
         # Re-saving the SAME step over an existing same-step checkpoint
         # reuses the s<step>_ filenames, so a crash mid-overwrite could
@@ -289,6 +291,56 @@ def _write_sharded(
                     old_meta.unlink()
             except (OSError, ValueError):
                 old_meta.unlink(missing_ok=True)
+        # Attempt marker, written strictly AFTER the retraction: its
+        # presence tells the other processes the old same-step meta is
+        # gone (so overwriting s<step>_ blobs can no longer corrupt a
+        # live checkpoint), and its mtime is the freshness bar every
+        # referenced blob must meet before meta republishes — old blobs
+        # at the same filenames no longer satisfy the publish wait.
+        path.mkdir(parents=True, exist_ok=True)
+        mtmp = marker.with_name(marker.name + ".tmp")
+        mtmp.write_text(json.dumps({"step": meta["step"]}))
+        mtmp.rename(marker)
+    elif blobs:
+        # Cluster-wide ordering for the retraction: do not overwrite
+        # possibly-live same-step blobs until process 0 has (a) written
+        # this attempt's marker and (b) any same-step meta.json is gone.
+        # A crash while we wait leaves the old checkpoint fully intact.
+        # A process with NO blobs to write skips the gate entirely — it
+        # cannot corrupt anything, and by the time it looks, process 0
+        # may already have published this attempt's meta and removed the
+        # marker (which would read as a spurious timeout here).
+        deadline = time.monotonic() + publish_timeout_s
+        while True:
+            meta_f = path / "meta.json"
+            blocked = False
+            if meta_f.exists():
+                try:
+                    blocked = (
+                        json.loads(meta_f.read_text()).get("step")
+                        == meta["step"]
+                    )
+                except (OSError, ValueError):
+                    blocked = True  # mid-change/garbage: wait for clarity
+            marker_ok = False
+            if marker.exists():
+                try:
+                    marker_ok = (
+                        json.loads(marker.read_text()).get("step")
+                        == meta["step"]
+                    )
+                except (OSError, ValueError):
+                    marker_ok = False
+            if marker_ok and not blocked:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"sharded checkpoint {path}: process 0 did not retract "
+                    f"the step-{meta['step']} meta.json and publish a save "
+                    f"marker within {publish_timeout_s:.0f}s — refusing to "
+                    "overwrite blobs a live meta may still reference"
+                )
+            time.sleep(0.05)
     for rel, shape, raw in blobs:
         f = path / rel
         f.parent.mkdir(parents=True, exist_ok=True)
@@ -312,16 +364,30 @@ def _write_sharded(
             for i, rec in enumerate(meta["leaves"])
             for shard in rec["shards"]
         ]
+        try:
+            bar = marker.stat().st_mtime
+        except OSError:
+            bar = 0.0
+
+        def _stale(f: Path) -> bool:
+            # Same-step re-saves reuse filenames, so existence is not
+            # enough: a blob counts only once its mtime reaches this
+            # attempt's marker (same filesystem clock stamps both).
+            try:
+                return f.stat().st_mtime < bar
+            except OSError:
+                return True  # absent
+
         deadline = time.monotonic() + publish_timeout_s
-        missing = [f for f in referenced if not f.exists()]
+        missing = [f for f in referenced if _stale(f)]
         while missing and time.monotonic() < deadline:
             time.sleep(0.05)
-            missing = [f for f in missing if not f.exists()]
+            missing = [f for f in missing if _stale(f)]
         if missing:
             raise RuntimeError(
                 f"sharded checkpoint {path}: {len(missing)} shard file(s) "
-                f"still missing after {publish_timeout_s:.0f}s (e.g. "
-                f"{missing[0]}) — not publishing meta.json over an "
+                f"still missing or stale after {publish_timeout_s:.0f}s "
+                f"(e.g. {missing[0]}) — not publishing meta.json over an "
                 "incomplete checkpoint"
             )
         tmp = path / "meta.json.tmp"
@@ -338,6 +404,46 @@ def _write_sharded(
                         f.unlink()
                     except OSError:
                         pass
+        marker.unlink(missing_ok=True)  # attempt complete
+    elif blobs:
+        # Wait for process 0 to publish this attempt's meta, re-touching
+        # our blobs whenever the marker postdates them.  This closes the
+        # stale-marker race: a marker left by a CRASHED same-step attempt
+        # can let this process pass the retraction gate and write blobs
+        # BEFORE process 0 rewrites the marker — those blobs would then
+        # sit below the publish wait's freshness bar forever.  Process 0
+        # writes the marker exactly once per attempt, so one re-touch
+        # after that settles every file.
+        mine = [path / rel for rel, _, _ in blobs]
+        deadline = time.monotonic() + publish_timeout_s
+        while True:
+            try:
+                if (
+                    json.loads((path / "meta.json").read_text()).get("step")
+                    == meta["step"]
+                ):
+                    break
+            except (OSError, ValueError):
+                pass
+            try:
+                bar = marker.stat().st_mtime
+            except OSError:
+                bar = None
+            if bar is not None:
+                for f in mine:
+                    try:
+                        if f.stat().st_mtime < bar:
+                            os.utime(f)
+                    except OSError:
+                        pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"sharded checkpoint {path}: process 0 did not publish "
+                    f"the step-{meta['step']} meta.json within "
+                    f"{publish_timeout_s:.0f}s of this process writing its "
+                    "shards — checkpoint is incomplete"
+                )
+            time.sleep(0.05)
 
 
 def save_sharded(path: str | Path, tree: Any, *, step: int = 0) -> None:

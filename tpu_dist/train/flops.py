@@ -53,6 +53,17 @@ _HBM_BW: dict[str, float] = {
 }
 
 
+def _longest_prefix_match(table: dict[str, float], kind: str) -> float | None:
+    """Most-specific (longest) prefix match: 'TPU v5 lite' must win over
+    'TPU v5' for a v5e regardless of dict insertion order."""
+    best: float | None = None
+    best_len = -1
+    for name, value in table.items():
+        if kind.lower().startswith(name.lower()) and len(name) > best_len:
+            best, best_len = value, len(name)
+    return best
+
+
 def hbm_bandwidth(device: Any | None = None) -> float | None:
     """Per-chip HBM bandwidth (bytes/s); None when unknown (CPU-sim)."""
     import jax
@@ -60,10 +71,7 @@ def hbm_bandwidth(device: Any | None = None) -> float | None:
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "") or ""
-    for name, bw in _HBM_BW.items():
-        if kind.lower().startswith(name.lower()):
-            return bw
-    return None
+    return _longest_prefix_match(_HBM_BW, kind)
 
 
 def peak_flops(device: Any | None = None) -> float | None:
@@ -77,10 +85,7 @@ def peak_flops(device: Any | None = None) -> float | None:
     if device is None:
         device = jax.devices()[0]
     kind = getattr(device, "device_kind", "") or ""
-    for name, peak in _PEAK_BF16.items():
-        if kind.lower().startswith(name.lower()):
-            return peak
-    return None
+    return _longest_prefix_match(_PEAK_BF16, kind)
 
 
 def xla_flops(fn: Callable, *args: Any) -> float | None:
