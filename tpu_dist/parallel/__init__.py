@@ -43,6 +43,11 @@ from tpu_dist.parallel.fsdp import (
     make_fsdp_train_step,
     make_zero1_train_step,
 )
+from tpu_dist.parallel.overlap import (
+    allgather_matmul,
+    matmul_reduce_scatter,
+    tp_mlp_overlapped,
+)
 from tpu_dist.parallel.ulysses import ulysses_attention
 from tpu_dist.parallel.tensor_parallel import (
     MODEL_AXIS,
@@ -76,6 +81,8 @@ __all__ = [
     "gpipe_ticks",
     "interleaved_bubble_fraction",
     "interleaved_ticks",
+    "allgather_matmul",
+    "matmul_reduce_scatter",
     "moe_mlp",
     "moe_mlp_top2",
     "pipeline_apply",
@@ -93,6 +100,7 @@ __all__ = [
     "tp_encoder_block",
     "tp_mlp",
     "tp_mlp_block",
+    "tp_mlp_overlapped",
     "tp_vocab_cross_entropy",
     "make_fsdp_train_step",
     "make_zero1_train_step",

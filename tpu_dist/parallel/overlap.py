@@ -1,0 +1,145 @@
+"""Collective matmuls: communication overlapped INTO the matmul.
+
+The reference's didactic gap is that its gradient averaging is a blocking
+per-parameter collective after the computation (train_dist.py:94-100;
+tuto.md:319-320 names overlap as what real DDP adds).  The TPU-native
+version of "overlap communication with computation" goes further than
+bucketing: for tensor-parallel layers whose activations are
+sequence-sharded (the Megatron-SP layout), the all-gather/reduce-scatter
+around a sharded matmul can be decomposed into a ``ppermute`` ring whose
+hops ride ICI *while* the MXU chews the chunk that already arrived — the
+"collective matmul" pattern of the scaling playbook.
+
+Structure, not scheduling: these functions EXPOSE the overlap by making
+each ring hop independent of the chunk-matmul issued alongside it; XLA's
+async collectives + latency-hiding scheduler do the actual interleaving
+on TPU (on the CPU-sim mesh they are merely correct).
+
+Layout convention: the FIRST axis of an activation is the token axis and
+is the sharded one; gathered outputs are rank-major along it.  The pair
+
+- `allgather_matmul`   — ``all_gather(x) @ w`` without waiting for the
+  gather: rank r multiplies its resident chunk while the ring rotates
+  the others in (n chunk-matmuls, n-1 hops).
+- `matmul_reduce_scatter` — ``reduce_scatter(x @ w)`` without
+  materializing the full product: the accumulator for each output chunk
+  travels the ring, gaining one rank's chunk-matmul per hop (owner adds
+  last).
+
+compose into `tp_mlp_overlapped`, the sequence-parallel Megatron MLP:
+activations enter and leave sequence-sharded (1/n of the activation
+memory of `tp_mlp`), and neither collective is a standalone barrier.
+
+Cross-checked against ``all_gather``/``psum_scatter`` and the dense
+computation in tests/test_overlap.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_dist.comm.collectives import ring_perm as _ring_perm
+from tpu_dist.parallel.tensor_parallel import MODEL_AXIS, shard_dim
+
+
+def allgather_matmul(
+    x_shard: jax.Array, w: jax.Array, axis_name: str = MODEL_AXIS
+) -> jax.Array:
+    """``all_gather(x_shard, tiled) @ w`` with the gather decomposed into
+    a ppermute ring overlapped with per-chunk matmuls.
+
+    ``x_shard``: (rows_l, d) — this rank's row chunk (rank-major order).
+    ``w``: (d, f) — typically a column-parallel weight shard, but any
+    per-rank right operand works.  Returns (n * rows_l, f): the full-row
+    product every rank can use locally.
+
+    Step i multiplies the chunk that originated at rank ``r - i`` (it has
+    hopped i times) into its output slot while the ring forwards it on —
+    the matmul for hop i and the permute for hop i+1 have no data
+    dependence, which is what lets the scheduler overlap them.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x_shard @ w
+    r = lax.axis_index(axis_name)
+    rows_l = x_shard.shape[0]
+    perm = _ring_perm(n)
+    out = jnp.zeros((n * rows_l, w.shape[1]), jnp.result_type(x_shard, w))
+    chunk = x_shard
+    for i in range(n):
+        src = (r - i) % n  # originating rank of the resident chunk
+        out = lax.dynamic_update_slice_in_dim(
+            out, (chunk @ w).astype(out.dtype), src * rows_l, 0
+        )
+        if i < n - 1:  # last chunk needs no forwarding
+            chunk = lax.ppermute(chunk, axis_name, perm)
+    return out
+
+
+def matmul_reduce_scatter(
+    x: jax.Array, w: jax.Array, axis_name: str = MODEL_AXIS
+) -> jax.Array:
+    """``psum_scatter(x @ w)`` over row chunks, with the ring reduction
+    overlapped with the per-chunk matmuls.
+
+    ``x``: (rows, d_l) — rows divisible by the axis size; typically the
+    hidden activations entering a row-parallel weight shard ``w``
+    (d_l, f).  Returns (rows / n, f): row chunk r of the full product,
+    summed over every rank's partial contribution.
+
+    The accumulator for chunk c starts at rank c+1 and travels left,
+    collecting one rank's chunk-matmul per hop; the owner contributes
+    last, so after n-1 hops rank r holds exactly chunk r.  Each hop's
+    permute is independent of the matmul for the incoming chunk.
+    """
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return x @ w
+    r = lax.axis_index(axis_name)
+    rows = x.shape[0]
+    if rows % n:
+        raise ValueError(f"rows {rows} not divisible by axis size {n}")
+    rows_l = rows // n
+    send_left = [(i, (i - 1) % n) for i in range(n)]
+
+    def partial(c):
+        return lax.dynamic_slice_in_dim(x, c * rows_l, rows_l, 0) @ w
+
+    acc = partial((r + 1) % n)
+    for i in range(1, n):
+        acc = lax.ppermute(acc, axis_name, send_left)
+        acc = acc + partial((r + 1 + i) % n)
+    return acc
+
+
+def tp_mlp_overlapped(
+    x_shard: jax.Array,
+    mlp_params,
+    axis_name: str = MODEL_AXIS,
+    *,
+    activation=jax.nn.gelu,
+) -> jax.Array:
+    """The sequence-parallel Megatron MLP with both collectives folded
+    into their matmuls: ``activation(AG(x) @ W1 + b1) @ W2 -> RS``.
+
+    ``x_shard``: (b, s_l, d) or (s_l, d) — this rank's sequence chunk of
+    the replicated-model activations.  ``mlp_params`` is the model zoo's
+    MLP pytree ``{"fc1": {"w","b"}, "fc2": {"w","b"}}``, passed
+    replicated; each rank slices its column shard of fc1 and row shard of
+    fc2 (same contract as `tp_mlp_block`).  Output has ``x_shard``'s
+    shape: activations stay sequence-sharded through the block, using
+    1/n of `tp_mlp_block`'s activation memory and replacing its psum
+    with a gather+scatter pair that never stands alone as a barrier.
+    """
+    w1 = shard_dim(mlp_params["fc1"]["w"], axis_name, 1)
+    b1 = shard_dim(mlp_params["fc1"]["b"], axis_name, 0)
+    w2 = shard_dim(mlp_params["fc2"]["w"], axis_name, 0)
+    b2 = mlp_params["fc2"]["b"]
+
+    lead = x_shard.shape[:-1]
+    x2d = x_shard.reshape(-1, x_shard.shape[-1])
+    hidden = activation(allgather_matmul(x2d, w1, axis_name) + b1)
+    out = matmul_reduce_scatter(hidden, w2, axis_name) + b2
+    return out.reshape(*lead, out.shape[-1])
