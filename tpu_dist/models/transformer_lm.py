@@ -275,6 +275,58 @@ class TransformerLM(Module):
             h[:, :-1], params["embed"]["table"], tokens[:, 1:], axis_name
         )
 
+    def apply_tensor_parallel_sp(self, params, tokens_local, axis_name):
+        """Megatron-SP tensor-parallel forward for use INSIDE shard_map:
+        ``tokens_local`` is this rank's SEQUENCE shard (rank-major global
+        order), activations stay sequence-sharded between sublayers (1/n
+        of `apply_tensor_parallel`'s activation memory), and every
+        all-gather/reduce-scatter is a collective matmul
+        (`parallel.tp_encoder_block_sp` — the overlap the reference names
+        as the per-parameter-loop vs real-DDP gap, tuto.md:319-320,
+        applied at layer granularity).  Heads and MLP hidden dims shard
+        over ``axis_name`` exactly like `apply_tensor_parallel`.  Returns
+        this rank's LOCAL logits ``(b, s_local, vocab)``; gathering them
+        over the axis reproduces the dense `apply` (tested)."""
+        from jax import lax
+
+        from tpu_dist.parallel.overlap import tp_encoder_block_sp
+
+        if self.pos_embedding != "learned":
+            raise ValueError(
+                "apply_tensor_parallel_sp supports learned positions only "
+                "(tp_attention_overlapped does not apply rope)"
+            )
+        if self.kv_heads != self.heads:
+            raise ValueError(
+                "apply_tensor_parallel_sp requires kv_heads == heads "
+                "(fused-QKV layout)"
+            )
+        b, s_local = tokens_local.shape
+        n = lax.axis_size(axis_name)
+        if n * s_local > self.max_seq:
+            raise ValueError(
+                f"global sequence {n} ranks x {s_local} tokens = "
+                f"{n * s_local} exceeds max_seq {self.max_seq}"
+            )
+        r = lax.axis_index(axis_name)
+        h = self._trunk(params, tokens_local, pos_offset=r * s_local)
+        for blk, pb in zip(self.blocks, params["blocks"]):
+            h = tp_encoder_block_sp(blk, pb, h, axis_name)
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        return h @ params["embed"]["table"].T
+
+    def loss_tensor_parallel_sp(self, params, tokens_local, axis_name):
+        """Next-token loss over the Megatron-SP forward: local logits +
+        `lm_loss_seq_parallel`'s boundary ppermute (each shard's first
+        token travels left to become its left neighbor's last target).
+        The ``pmean`` over ``axis_name`` equals the dense `lm_loss`
+        (tested) — so the model axis folds into the gradient average like
+        a data axis, same contract as `loss_tensor_parallel`."""
+        logits_local = self.apply_tensor_parallel_sp(
+            params, tokens_local, axis_name
+        )
+        return lm_loss_seq_parallel(logits_local, tokens_local, axis_name)
+
     def apply_pipeline(
         self, params, tokens, axis_name, *,
         n_microbatches: int = 4, interleave: int = 1,

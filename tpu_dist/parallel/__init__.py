@@ -46,6 +46,8 @@ from tpu_dist.parallel.fsdp import (
 from tpu_dist.parallel.overlap import (
     allgather_matmul,
     matmul_reduce_scatter,
+    tp_attention_overlapped,
+    tp_encoder_block_sp,
     tp_mlp_overlapped,
 )
 from tpu_dist.parallel.ulysses import ulysses_attention
@@ -99,6 +101,8 @@ __all__ = [
     "tp_embedding",
     "tp_encoder_block",
     "tp_mlp",
+    "tp_attention_overlapped",
+    "tp_encoder_block_sp",
     "tp_mlp_block",
     "tp_mlp_overlapped",
     "tp_vocab_cross_entropy",

@@ -114,6 +114,93 @@ def matmul_reduce_scatter(
     return acc
 
 
+def tp_attention_overlapped(
+    x_shard: jax.Array,
+    attn_params,
+    heads: int,
+    axis_name: str = MODEL_AXIS,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """Sharded-heads attention with SEQUENCE-SHARDED activations: the
+    all-gather before the QKV projection and the reduce-scatter after the
+    output projection are collective matmuls (Megatron-SP attention).
+
+    ``x_shard``: (b, s_l, d) — rank r holds global positions
+    ``r*s_l .. (r+1)*s_l - 1`` (rank-major sequence order).
+    ``attn_params``: the fused-QKV pytree (``{"qkv", "out"}``,
+    `nn.MultiHeadAttention` with ``kv_heads == heads``); each rank slices
+    its ``heads/n`` head shard exactly like `tp_attention`.  Attention
+    itself runs over the FULL gathered sequence on the local heads (the
+    softmax needs every position — that is why SP gathers here), and the
+    output returns sequence-sharded.  Dropout-free, like
+    `tp_encoder_block`.
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    if heads % n:
+        raise ValueError(f"heads {heads} not divisible by axis size {n}")
+    if "qkv" not in attn_params:
+        raise ValueError(
+            "tp_attention_overlapped supports the fused-QKV layout only "
+            "(kv_heads == heads); the replicated GQA K/V projection would "
+            "need a second gather of x"
+        )
+    hl = heads // n
+    b, s_l, d = x_shard.shape
+    w = attn_params["qkv"]["w"]
+    hd = w.shape[1] // (3 * heads)
+    w_loc = lax.dynamic_slice_in_dim(
+        w.reshape(d, 3, heads, hd), r * hl, hl, 2
+    ).reshape(d, 3 * hl * hd)
+    b_loc = lax.dynamic_slice_in_dim(
+        attn_params["qkv"]["b"].reshape(3, heads, hd), r * hl, hl, 1
+    ).reshape(3 * hl * hd)
+
+    qkv_rows = (
+        allgather_matmul(x_shard.reshape(b * s_l, d), w_loc, axis_name) + b_loc
+    )  # (n*b*s_l, 3*hl*hd), rank-major chunks = global sequence order
+    qkv = qkv_rows.reshape(n, b, s_l, 3, hl, hd)
+    # (n, b, s_l, hl, hd) -> (b, hl, S, hd); chunk index n IS the outer
+    # sequence index, so merging (n, s_l) reconstructs global order
+    q, k, v = (
+        qkv[:, :, :, i].transpose(1, 3, 0, 2, 4).reshape(b, hl, n * s_l, hd)
+        for i in range(3)
+    )
+
+    from tpu_dist.nn.attention import dot_product_attention
+
+    o = dot_product_attention(q, k, v, causal=causal)  # (b, hl, S, hd)
+    # back to rank-major rows for the reduce-scatter
+    o_rows = (
+        o.reshape(b, hl, n, s_l, hd)
+        .transpose(2, 0, 3, 1, 4)
+        .reshape(n * b * s_l, hl * hd)
+    )
+    wo_loc = lax.dynamic_slice_in_dim(
+        attn_params["out"]["w"], r * hl * hd, hl * hd, 0
+    )
+    out = matmul_reduce_scatter(o_rows, wo_loc, axis_name)  # (b*s_l, d)
+    return out.reshape(b, s_l, d) + attn_params["out"]["b"]
+
+
+def tp_encoder_block_sp(block, params, x_shard, axis_name: str = MODEL_AXIS):
+    """A full pre-norm transformer block in the Megatron-SP layout:
+    activations stay SEQUENCE-SHARDED between sublayers (1/n of
+    `tp_encoder_block`'s activation memory), LayerNorms run token-local
+    on replicated params, and all four collectives are folded into their
+    matmuls (`tp_attention_overlapped` + `tp_mlp_overlapped`).  ``block``
+    is the EncoderBlock instance; ``params`` its replicated pytree.
+    Numerics match ``block.apply`` on the gathered sequence (tested)."""
+    h, _ = block.ln1.apply(params["ln1"], {}, x_shard)
+    x = x_shard + tp_attention_overlapped(
+        h, params["attn"], block.attn.heads, axis_name,
+        causal=block.attn.causal,
+    )
+    h, _ = block.ln2.apply(params["ln2"], {}, x)
+    return x + tp_mlp_overlapped(h, params["mlp"], axis_name)
+
+
 def tp_mlp_overlapped(
     x_shard: jax.Array,
     mlp_params,
