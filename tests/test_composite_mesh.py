@@ -281,3 +281,55 @@ def test_fsdp_tp_lm_training_step_matches_dense(lm):
         np.testing.assert_allclose(
             np.asarray(e), np.asarray(g), rtol=2e-4, atol=2e-5
         )
+
+
+def test_dp_sptp_lm_training_step_matches_dense(lm):
+    """DP x Megatron-SP: batch sharded over 'data', sequence AND
+    heads/hidden sharded over 'model' (loss_tensor_parallel_sp — the
+    collective-matmul layout), grads pmean'd over both axes — one SGD
+    update equals the dense update.  Same gradient contract as the psum
+    TP path: the model-axis mean recovers the dense grad."""
+    DPn, TPn = 2, 2
+    mesh = comm.make_mesh((DPn, TPn), ("data", "model"), platform="cpu")
+    params, _ = lm.init(jax.random.key(1))
+    tokens = models.synthetic_tokens(B, S, V)
+    lr = 0.1
+
+    def dense_next(params):
+        def loss_fn(p):
+            logits, _ = lm.apply(p, {}, tokens)
+            return models.lm_loss(logits, tokens)
+
+        g = jax.grad(loss_fn)(params)
+        return jax.tree.map(lambda p, g_: p - lr * g_, params, g)
+
+    expect = dense_next(params)
+
+    def spmd_step(params, tokens_local):
+        # tokens_local: (B/DPn, S/TPn) — batch shard x sequence shard
+        def loss_fn(p):
+            return lm.loss_tensor_parallel_sp(p, tokens_local, "model")
+
+        g = jax.grad(loss_fn)(params)
+        g = jax.tree.map(
+            lambda a: lax.pmean(lax.pmean(a, "model"), "data"), g
+        )
+        return jax.tree.map(lambda p, g_: p - lr * g_, params, g)
+
+    mapped = jax.jit(
+        jax.shard_map(
+            spmd_step,
+            mesh=mesh,
+            in_specs=(P(), P("data", "model")),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    got = mapped(
+        jax.device_put(params, NamedSharding(mesh, P())),
+        jax.device_put(tokens, NamedSharding(mesh, P("data", "model"))),
+    )
+    for e, g in zip(jax.tree.leaves(expect), jax.tree.leaves(got)):
+        np.testing.assert_allclose(
+            np.asarray(e), np.asarray(g), rtol=2e-4, atol=2e-5
+        )
