@@ -26,6 +26,40 @@ from tpu_dist.nn.core import Module
 from tpu_dist.models.vit import EncoderBlock
 
 
+def _make_sampler(temperature, top_k, top_p, dtype):
+    """The decode sampling rule, shared by `TransformerLM.generate` and
+    `generate_tensor_parallel`: greedy at ``temperature=0``, otherwise
+    tempered softmax optionally truncated to the ``top_k`` highest logits
+    and/or the ``top_p`` nucleus.  Deterministic given the key, so every
+    model-parallel rank sampling replicated logits with the same key
+    picks the same token."""
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(dtype)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p is not None:
+            # nucleus: drop tokens in the tail beyond cumulative
+            # probability top_p (the highest-probability token always
+            # survives: its exclusive-cumsum is 0 < top_p)
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+            cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True) - 1
+            cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
+        return jax.random.categorical(k, logits).astype(dtype)
+
+    return sample
+
+
 class TransformerLM(Module):
     def __init__(
         self,
@@ -183,33 +217,9 @@ class TransformerLM(Module):
             raise ValueError(
                 f"prompt {s_p} + steps {steps} exceeds cache length {L}"
             )
-        if top_k is not None and top_k < 1:
-            raise ValueError(f"top_k must be >= 1, got {top_k}")
-        if top_p is not None and not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if key is None:
             key = jax.random.key(0)
-
-        def sample(logits, k):
-            if temperature == 0.0:
-                return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-            logits = logits / temperature
-            if top_k is not None:
-                kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-                logits = jnp.where(logits < kth, -1e30, logits)
-            if top_p is not None:
-                # nucleus: drop tokens in the tail beyond cumulative
-                # probability top_p (the highest-probability token always
-                # survives: its exclusive-cumsum is 0 < top_p)
-                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True) - 1
-                cutoff = jnp.take_along_axis(
-                    sorted_logits, cutoff_idx, axis=-1
-                )
-                logits = jnp.where(logits < cutoff, -1e30, logits)
-            return jax.random.categorical(k, logits).astype(prompt.dtype)
+        sample = _make_sampler(temperature, top_k, top_p, prompt.dtype)
 
         cache = self.init_cache(b, L, dtype=params["embed"]["table"].dtype)
         logits, cache = self.apply_cached(params, prompt, cache, 0)
@@ -326,6 +336,109 @@ class TransformerLM(Module):
             params, tokens_local, axis_name
         )
         return lm_loss_seq_parallel(logits_local, tokens_local, axis_name)
+
+    def init_cache_tp(self, batch, axis_name, cache_len=None, dtype=None):
+        """Per-rank KV cache for tensor-parallel decode, built INSIDE
+        shard_map: each rank caches only its ``heads / n`` head shard —
+        ``(batch, heads/n, cache_len, head_dim)`` — so cache HBM drops
+        n-fold per chip (the serving reason to decode tensor-parallel)."""
+        from jax import lax
+
+        n = lax.axis_size(axis_name)
+        if self.heads % n:
+            raise ValueError(
+                f"heads {self.heads} not divisible by axis size {n}"
+            )
+        if self.kv_heads != self.heads:
+            raise ValueError(
+                "init_cache_tp requires kv_heads == heads (fused-QKV "
+                "layout; the GQA cache is not head-sharded)"
+            )
+        L = cache_len or self.max_seq
+        hd = self.dim // self.heads
+        z = jnp.zeros((batch, self.heads // n, L, hd), dtype or jnp.float32)
+        return [{"k": z, "v": z} for _ in self.blocks]
+
+    def apply_cached_tensor_parallel(
+        self, params, tokens, cache, index, axis_name
+    ):
+        """Tensor-parallel `apply_cached` for use INSIDE shard_map:
+        sharded-heads incremental attention against the per-rank cache
+        (`parallel.tp_attention_cached`) + the Megatron MLP — two psums
+        per block, replicated logits out.  Same replicated params as
+        `apply`; tests assert the gathered decode equals the dense one."""
+        from tpu_dist.parallel.tensor_parallel import (
+            tp_attention_cached,
+            tp_mlp_block,
+        )
+
+        h = self._trunk(params, tokens, pos_offset=index)
+        new_cache = []
+        for blk, pb, c in zip(self.blocks, params["blocks"], cache):
+            x1, _ = blk.ln1.apply(pb["ln1"], {}, h)
+            o, ck, cv = tp_attention_cached(
+                x1, pb["attn"], blk.attn.heads, c["k"], c["v"], index,
+                axis_name, use_rope=self.pos_embedding == "rope",
+            )
+            h = h + o
+            x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
+            h = h + tp_mlp_block(x2, pb["mlp"], axis_name)
+            new_cache.append({"k": ck, "v": cv})
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        logits = h @ params["embed"]["table"].T
+        return logits, new_cache
+
+    def generate_tensor_parallel(
+        self,
+        params,
+        prompt,
+        steps: int,
+        axis_name,
+        *,
+        key=None,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        cache_len: int | None = None,
+    ):
+        """`generate` with the model tensor-parallel, for use INSIDE
+        shard_map over ``axis_name``: one prefill + a ``lax.scan`` of
+        single-token steps, heads and KV cache sharded n-ways, logits
+        replicated by the per-block psum so every rank samples the SAME
+        token from the same key (sampling is deterministic given both).
+        Multi-chip serving: n chips' HBM bandwidth reads one model —
+        the decode-latency analog of the training-side sharding."""
+        from jax import lax
+
+        b, s_p = prompt.shape
+        L = cache_len or self.max_seq
+        if s_p + steps > L:
+            raise ValueError(
+                f"prompt {s_p} + steps {steps} exceeds cache length {L}"
+            )
+        if key is None:
+            key = jax.random.key(0)
+        sample = _make_sampler(temperature, top_k, top_p, prompt.dtype)
+
+        cache = self.init_cache_tp(
+            b, axis_name, L, dtype=params["embed"]["table"].dtype
+        )
+        logits, cache = self.apply_cached_tensor_parallel(
+            params, prompt, cache, 0, axis_name
+        )
+        last = logits[:, -1]
+
+        def body(carry, k):
+            cache, last, idx = carry
+            tok = sample(last, k)
+            logits, cache = self.apply_cached_tensor_parallel(
+                params, tok[:, None], cache, idx, axis_name
+            )
+            return (cache, logits[:, 0], idx + 1), tok
+
+        keys = jax.random.split(key, steps)
+        _, toks = lax.scan(body, (cache, last, jnp.int32(s_p)), keys)
+        return jnp.moveaxis(toks, 0, 1)
 
     def apply_pipeline(
         self, params, tokens, axis_name, *,

@@ -57,6 +57,7 @@ from tpu_dist.parallel.tensor_parallel import (
     row_parallel,
     shard_dim,
     tp_attention,
+    tp_attention_cached,
     tp_embedding,
     tp_encoder_block,
     tp_mlp,
@@ -98,6 +99,7 @@ __all__ = [
     "row_parallel",
     "shard_dim",
     "tp_attention",
+    "tp_attention_cached",
     "tp_embedding",
     "tp_encoder_block",
     "tp_mlp",
