@@ -420,7 +420,28 @@ class TestTensorParallelDecode:
         # 4 heads over 4 ranks -> 1 local head per rank
         assert out.shape == (4, 2, 1, 16, 8)
 
-    def test_gqa_cache_tp_raises(self):
+    def test_tp_generate_gqa(self):
+        """GQA composes with TP decode: kv heads shard the same way, each
+        rank expanding its kv slice for its q-head groups."""
+        lm_gqa = models.TransformerLM(
+            vocab=32, dim=16, depth=1, heads=4, kv_heads=2, max_seq=32
+        )
+        params, _ = lm_gqa.init(jax.random.key(4))
+        prompt = models.synthetic_tokens(2, 5, 32, seed=6)
+        dense = np.asarray(lm_gqa.generate(params, prompt, 7))
+
+        def fn(params, prompt):
+            from tpu_dist import comm
+
+            return lm_gqa.generate_tensor_parallel(
+                params, prompt, 7, comm.DEFAULT_AXIS
+            )
+
+        out = np.asarray(self._run_tp(fn, params, prompt, world=2))
+        for r in range(2):
+            np.testing.assert_array_equal(out[r], dense)
+
+    def test_gqa_cache_tp_indivisible_raises(self):
         lm_gqa = models.TransformerLM(
             vocab=16, dim=16, depth=1, heads=4, kv_heads=2, max_seq=16
         )
@@ -428,5 +449,5 @@ class TestTensorParallelDecode:
 
         with pytest.raises(ValueError, match="kv_heads"):
             self._run_tp(
-                lambda: lm_gqa.init_cache_tp(1, comm.DEFAULT_AXIS), world=2
+                lambda: lm_gqa.init_cache_tp(1, comm.DEFAULT_AXIS), world=4
             )

@@ -339,9 +339,11 @@ class TransformerLM(Module):
 
     def init_cache_tp(self, batch, axis_name, cache_len=None, dtype=None):
         """Per-rank KV cache for tensor-parallel decode, built INSIDE
-        shard_map: each rank caches only its ``heads / n`` head shard —
-        ``(batch, heads/n, cache_len, head_dim)`` — so cache HBM drops
-        n-fold per chip (the serving reason to decode tensor-parallel)."""
+        shard_map: each rank caches only its head shard —
+        ``(batch, kv_heads/n, cache_len, head_dim)`` — so cache HBM
+        drops n-fold per chip (the serving reason to decode
+        tensor-parallel).  GQA composes: the smaller kv-head set shards
+        the same way (``kv_heads % n == 0`` required)."""
         from jax import lax
 
         n = lax.axis_size(axis_name)
@@ -349,14 +351,16 @@ class TransformerLM(Module):
             raise ValueError(
                 f"heads {self.heads} not divisible by axis size {n}"
             )
-        if self.kv_heads != self.heads:
+        if self.kv_heads % n:
             raise ValueError(
-                "init_cache_tp requires kv_heads == heads (fused-QKV "
-                "layout; the GQA cache is not head-sharded)"
+                f"kv_heads {self.kv_heads} not divisible by axis size "
+                f"{n} — the per-rank KV cache cannot be head-sharded"
             )
         L = cache_len or self.max_seq
         hd = self.dim // self.heads
-        z = jnp.zeros((batch, self.heads // n, L, hd), dtype or jnp.float32)
+        z = jnp.zeros(
+            (batch, self.kv_heads // n, L, hd), dtype or jnp.float32
+        )
         return [{"k": z, "v": z} for _ in self.blocks]
 
     def apply_cached_tensor_parallel(

@@ -183,14 +183,19 @@ def tp_attention_cached(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Sharded-heads incremental attention for tensor-parallel DECODE:
     each rank runs ``heads / n`` complete heads against its OWN slice of
-    the KV cache (``(b, heads/n, L, head_dim)`` per rank — cache HBM and
-    attention FLOPs both drop n-fold per chip) and the row-parallel
-    output projection finishes with ONE psum, exactly like
-    `tp_attention`.  Same math as `nn.MultiHeadAttention.apply_cached`
-    restricted to the local heads (tests assert the gathered decode
-    matches the dense one).  Fused-QKV layout only (``kv_heads ==
-    heads``); rope rotates the local q/k by absolute position, which is
-    head-independent, so both position schemes work.
+    the KV cache — cache HBM and attention FLOPs both drop n-fold per
+    chip — and the row-parallel output projection finishes with ONE
+    psum, exactly like `tp_attention`.  Same math as
+    `nn.MultiHeadAttention.apply_cached` restricted to the local heads
+    (tests assert the gathered decode matches the dense one).
+
+    Layouts: fused QKV (``{"qkv","out"}``; per-rank cache
+    ``(b, heads/n, L, hd)``) or GQA (``{"q","kv","out"}``; requires
+    ``kv_heads % n == 0``, per-rank cache ``(b, kv_heads/n, L, hd)`` —
+    a query head's kv group never straddles ranks because contiguous
+    q-head shards map to contiguous kv-head shards).  Rope rotates the
+    local q/k by absolute position, which is head-independent, so both
+    position schemes work.
 
     ``x``: (b, s, d) replicated new tokens at global positions
     ``index..index+s-1``.  Returns ``(y replicated, k_cache, v_cache)``.
@@ -199,23 +204,47 @@ def tp_attention_cached(
     r = lax.axis_index(axis_name)
     if heads % n:
         raise ValueError(f"heads {heads} not divisible by axis size {n}")
-    if "qkv" not in attn_params:
-        raise ValueError(
-            "tp_attention_cached supports the fused-QKV layout only "
-            "(kv_heads == heads)"
-        )
     hl = heads // n
     b, s, d = x.shape
-    w = attn_params["qkv"]["w"]
-    hd = w.shape[1] // (3 * heads)
-    w_loc = lax.dynamic_slice_in_dim(
-        w.reshape(d, 3, heads, hd), r * hl, hl, 2
-    ).reshape(d, 3 * hl * hd)
-    b_loc = lax.dynamic_slice_in_dim(
-        attn_params["qkv"]["b"].reshape(3, heads, hd), r * hl, hl, 1
-    ).reshape(3 * hl * hd)
-    qkv = (x @ w_loc + b_loc).reshape(b, s, 3, hl, hd)
-    q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    if "qkv" in attn_params:
+        group = 1  # local kv head j serves local q head j
+        w = attn_params["qkv"]["w"]
+        hd = w.shape[1] // (3 * heads)
+        w_loc = lax.dynamic_slice_in_dim(
+            w.reshape(d, 3, heads, hd), r * hl, hl, 2
+        ).reshape(d, 3 * hl * hd)
+        b_loc = lax.dynamic_slice_in_dim(
+            attn_params["qkv"]["b"].reshape(3, heads, hd), r * hl, hl, 1
+        ).reshape(3 * hl * hd)
+        qkv = (x @ w_loc + b_loc).reshape(b, s, 3, hl, hd)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+    else:  # GQA tree {"q", "kv", "out"}
+        wq = attn_params["q"]["w"]
+        hd = wq.shape[1] // heads
+        kv_heads = attn_params["kv"]["w"].shape[1] // (2 * hd)
+        if kv_heads % n:
+            raise ValueError(
+                f"kv_heads {kv_heads} not divisible by axis size {n} — "
+                "the per-rank KV cache cannot be head-sharded"
+            )
+        kvl = kv_heads // n
+        group = heads // kv_heads
+        wq_loc = lax.dynamic_slice_in_dim(
+            wq.reshape(d, heads, hd), r * hl, hl, 1
+        ).reshape(d, hl * hd)
+        bq_loc = lax.dynamic_slice_in_dim(
+            attn_params["q"]["b"].reshape(heads, hd), r * hl, hl, 0
+        ).reshape(hl * hd)
+        q = jnp.moveaxis((x @ wq_loc + bq_loc).reshape(b, s, hl, hd), 1, 2)
+        wkv_loc = lax.dynamic_slice_in_dim(
+            attn_params["kv"]["w"].reshape(d, 2, kv_heads, hd),
+            r * kvl, kvl, 2,
+        ).reshape(d, 2 * kvl * hd)
+        bkv_loc = lax.dynamic_slice_in_dim(
+            attn_params["kv"]["b"].reshape(2, kv_heads, hd), r * kvl, kvl, 1
+        ).reshape(2 * kvl * hd)
+        kv = (x @ wkv_loc + bkv_loc).reshape(b, s, 2, kvl, hd)
+        k, v = (jnp.moveaxis(kv[:, :, i], 1, 2) for i in range(2))
     if use_rope:
         from tpu_dist.nn.attention import rope
 
@@ -229,14 +258,19 @@ def tp_attention_cached(
     )
     cache_len = k_cache.shape[2]
     scale = hd**-0.5
+    # GQA: repeat each local kv head for its group of local q heads
+    # (local q head j reads local kv head j // group — the contiguous
+    # shard slices keep global alignment)
+    k_full = jnp.repeat(k_cache, group, axis=1) if group > 1 else k_cache
+    v_full = jnp.repeat(v_cache, group, axis=1) if group > 1 else v_cache
     logits = jnp.einsum(
-        "bhqd,bhkd->bhqk", q * scale, k_cache.astype(q.dtype)
+        "bhqd,bhkd->bhqk", q * scale, k_full.astype(q.dtype)
     )
     pos_k = jnp.arange(cache_len)[None, :]
     qpos = index + jnp.arange(s)[:, None]
     logits = jnp.where(pos_k <= qpos, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", weights, v_cache.astype(q.dtype))
+    o = jnp.einsum("bhqk,bhkd->bhqd", weights, v_full.astype(q.dtype))
     o = jnp.moveaxis(o, 1, 2).reshape(b, s, hl * hd)
     wo_loc = lax.dynamic_slice_in_dim(
         attn_params["out"]["w"], r * hl * hd, hl * hd, 0
