@@ -104,6 +104,42 @@ def _flash_forward(q3, k3, v3, causal, bq, bk, interpret):
     return out, lse
 
 
+def flash_attention_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    bq: int = 256,
+    bk: int = 256,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """`flash_attention` that ALSO returns the per-row log-sum-exp
+    ``(..., S)`` the kernel already computes for its backward pass.
+
+    The lse is what makes flash blocks composable: partial attentions
+    over disjoint key sets recombine exactly via
+    ``out = Σ exp(lse_b - m*) out_b / Σ exp(lse_b - m*)`` — the
+    ring-attention composition (`parallel.ring_attention_flash`).
+    Forward-only (no VJP); compositions define their own backward.
+    """
+    *lead, S, d = q.shape
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    bq = min(bq, S)
+    bk = min(bk, S)
+    if S % bq or S % bk:
+        raise ValueError(f"seq {S} not divisible by blocks ({bq}, {bk})")
+    bh = 1
+    for x in lead:
+        bh *= x
+    out, lse = _flash_forward(
+        q.reshape(bh, S, d), k.reshape(bh, S, d), v.reshape(bh, S, d),
+        causal, bq, bk, interpret,
+    )
+    return out.reshape(q.shape), lse[..., 0].reshape(*lead, S)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q3, k3, v3, causal, bq, bk, interpret):
     out, _ = _flash_forward(q3, k3, v3, causal, bq, bk, interpret)

@@ -14,7 +14,8 @@ from tpu_dist.parallel.data_parallel import (
     replicate,
     shard_batch,
 )
-from tpu_dist.parallel.ring_attention import (
+from tpu_dist.parallel.ring_attention import (  # noqa: I001
+    ring_attention_flash,
     RingMultiHeadAttention,
     ring_attention,
 )
@@ -118,6 +119,7 @@ __all__ = [
     "ring_all_reduce",
     "ring_all_reduce_chunked",
     "ring_attention",
+    "ring_attention_flash",
     "ring_reduce_scatter",
     "shard_batch",
     "ulysses_attention",
