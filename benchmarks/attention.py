@@ -55,9 +55,15 @@ def main():
         )
 
         def sharded(fn_name):
+            interp = args.platform == "cpu"  # Pallas needs interpret off-TPU
             fn = {
                 "ring": parallel.ring_attention,
                 "ulysses": parallel.ulysses_attention,
+                "ring_flash": lambda a, b, c, ax, causal: (
+                    parallel.ring_attention_flash(
+                        a, b, c, ax, causal=causal, interpret=interp
+                    )
+                ),
             }[fn_name]
             mapped = jax.jit(
                 jax.shard_map(
@@ -74,6 +80,7 @@ def main():
         for name, step in [
             ("full", lambda y: dot_product_attention(y, y, y, causal=args.causal)),
             ("ring", sharded("ring")),
+            ("ring_flash", sharded("ring_flash")),
             ("ulysses", sharded("ulysses")),
         ]:
             try:

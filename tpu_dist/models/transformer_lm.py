@@ -520,11 +520,17 @@ class TransformerLM(Module):
         h, _ = self.ln.apply(params["ln"], {}, h)
         return h @ params["embed"]["table"].T
 
-    def apply_seq_parallel(self, params, tokens_local, axis_name):
+    def apply_seq_parallel(self, params, tokens_local, axis_name, *,
+                           flash: bool = False, interpret: bool = False):
         """Sequence-parallel forward for use INSIDE shard_map: tokens are
         the local sequence shard; attention runs as a ppermute ring over
         ``axis_name``; everything else is token-local.  Same params as
-        `apply` — tests assert bitwise-tolerance agreement."""
+        `apply` — tests assert bitwise-tolerance agreement.
+
+        ``flash=True`` computes each ring block with the Pallas flash
+        kernel (`parallel.ring_attention_flash`) — same numbers, no
+        per-block (s_local, s_local) score materialization; ``interpret``
+        runs the kernel in interpret mode (CPU-sim testing)."""
         from jax import lax
 
         from tpu_dist.parallel.ring_attention import RingMultiHeadAttention
@@ -549,6 +555,7 @@ class TransformerLM(Module):
         ring_mha = RingMultiHeadAttention(
             self.dim, self.heads, axis_name=axis_name, causal=True,
             use_rope=self.pos_embedding == "rope",
+            use_flash=flash, interpret=interpret,
         )
         for blk, pb in zip(self.blocks, params["blocks"]):
             x1, _ = blk.ln1.apply(pb["ln1"], {}, h)

@@ -232,12 +232,19 @@ class RingMultiHeadAttention:
     """
 
     def __init__(self, dim: int, heads: int, *, axis_name: str,
-                 causal: bool = False, use_rope: bool = False):
+                 causal: bool = False, use_rope: bool = False,
+                 use_flash: bool = False, interpret: bool = False):
         from tpu_dist import nn  # local import: nn must not depend on parallel
 
         self.axis_name = axis_name
         self.causal = causal
         self.use_rope = use_rope
+        # use_flash: compute each ring block with the Pallas flash kernel
+        # (`ring_attention_flash`) instead of the dense blockwise core —
+        # same numbers, no (s_local, s_local) HBM round-trip per block.
+        # interpret only matters with use_flash (CPU-sim testing).
+        self.use_flash = use_flash
+        self.interpret = interpret
         self._dense = nn.MultiHeadAttention(
             dim, heads, causal=causal, use_rope=use_rope
         )
@@ -268,7 +275,13 @@ class RingMultiHeadAttention:
             r = lax.axis_index(self.axis_name)
             pos = r * s_local + jnp.arange(s_local)
             q, k = nn.rope(q, pos), nn.rope(k, pos)
-        o = ring_attention(q, k, v, self.axis_name, causal=self.causal)
+        if self.use_flash:
+            o = ring_attention_flash(
+                q, k, v, self.axis_name, causal=self.causal,
+                interpret=self.interpret,
+            )
+        else:
+            o = ring_attention(q, k, v, self.axis_name, causal=self.causal)
         o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, self.dim)
         y, _ = d._out.apply(params["out"], {}, o)
         return y, state
