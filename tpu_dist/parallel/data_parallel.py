@@ -51,9 +51,10 @@ def average_gradients(
     ``backend='ring'`` swaps in the hand-rolled chunked ppermute ring
     (`tpu_dist.parallel.ring_all_reduce_chunked`) — the reference's
     allreduce.py path used for its real purpose.  Numerically equivalent
-    (tests assert identical training).  ``backend='int8'`` uses the
-    quantized collective (`comm.all_reduce_quantized`, 4× less ICI
-    traffic, lossy — gradient-noise-level error).  ``'psum'`` (XLA
+    (tests assert identical training).  ``backend='int8'`` / ``'fp8'``
+    use the quantized collective (`comm.all_reduce_quantized`, 4× less
+    ICI traffic, lossy — gradient-noise-level error; fp8 = e4m3 wire,
+    relative precision for heavy-tailed gradients).  ``'psum'`` (XLA
     AllReduce) is the production default.
     """
     if backend == "psum":
@@ -65,11 +66,13 @@ def average_gradients(
         return jax.tree.map(
             lambda g: ring_all_reduce_chunked(g, axis_name) / n, grads
         )
-    if backend == "int8":
+    if backend in ("int8", "fp8"):
         from tpu_dist.comm.collectives import all_reduce_quantized
 
+        wire = "int8" if backend == "int8" else "float8_e4m3"
         return jax.tree.map(
-            lambda g: all_reduce_quantized(g, axis_name) / n, grads
+            lambda g: all_reduce_quantized(g, axis_name, dtype=wire) / n,
+            grads,
         )
     raise ValueError(f"unknown grad-reduce backend {backend!r}")
 
