@@ -98,3 +98,27 @@ def test_fsdp_lm_checkpoint_and_generate(mesh, windows, tmp_path):
 
     out = b.generate(np.zeros((1, 4), np.int32), steps=4)
     assert out.shape == (1, 4)  # the generated continuation
+
+
+@pytest.mark.parametrize("layout", ["psum", "sp"])
+def test_tensor_parallel_trainer_matches_data_parallel(mesh, windows, layout):
+    """LMTrainConfig(tensor_parallel=...) on a (data x model) mesh:
+    sharding the model (psum layout) or model+sequence (Megatron-SP
+    collective-matmul layout) must not change the training trajectory —
+    same global batch, same seed, fp-tolerance-equal loss history."""
+    dense_hist = _trainer(mesh).fit(windows, epochs=2)
+
+    mesh2d = comm.make_mesh((2, 2), ("data", "model"), platform="cpu")
+    tp_hist = _trainer(mesh2d, tensor_parallel=layout).fit(windows, epochs=2)
+    for d, t in zip(dense_hist, tp_hist):
+        assert t.mean_loss == pytest.approx(d.mean_loss, rel=2e-4)
+
+
+def test_tensor_parallel_validations(mesh):
+    with pytest.raises(ValueError, match="'psum' or 'sp'"):
+        _trainer(mesh, tensor_parallel="megatron")
+    with pytest.raises(ValueError, match="mesh axis"):
+        _trainer(mesh, tensor_parallel="sp")  # 1-D data mesh: no 'model'
+    mesh2d = comm.make_mesh((2, 2), ("data", "model"), platform="cpu")
+    with pytest.raises(ValueError, match="not combinable"):
+        _trainer(mesh2d, tensor_parallel="sp", fsdp=True)

@@ -142,9 +142,19 @@ def make_stateful_train_step(
     donate: bool = True,
     grad_reduce: str = "psum",
     accum_steps: int = 1,
+    extra_grad_axes: tuple[str, ...] = (),
+    batch_spec=None,
 ):
     """Like `make_train_step` but threads non-differentiated model state
     (e.g. batch-norm running statistics) through the step.
+
+    ``extra_grad_axes``: additional mesh axes to pmean gradients (and
+    loss/state/aux) over — the tensor-parallel gradient contract: a
+    model-sharded loss's per-rank grad is its shard's contribution, and
+    the model-axis mean recovers the dense gradient (tested for both TP
+    layouts).  ``batch_spec``: PartitionSpec for the batch (default
+    ``P(axis_name)``) — e.g. ``P('data', 'model')`` shards token windows
+    over batch AND sequence for the Megatron-SP layout.
 
     ``loss_fn(params, model_state, batch, key) -> (loss, (new_state, aux))``.
     Returns ``step(params, model_state, opt_state, batch, key) ->
@@ -211,11 +221,18 @@ def make_stateful_train_step(
         return grads, lsum / accum_steps, new_state, aux
 
     def spmd_step(params, model_state, opt_state, batch, key):
+        # fold over the DATA axis only: model-axis ranks run the same
+        # replicated computation and must share keys (dropout identity)
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
         local = grads_and_metrics if accum_steps == 1 else accumulate
         grads, loss, new_state, aux = local(params, model_state, batch, key)
         grads = average_gradients(grads, axis_name, backend=grad_reduce)
         loss = lax.pmean(loss, axis_name)
+        for ax in extra_grad_axes:
+            grads = jax.tree.map(lambda g: lax.pmean(g, ax), grads)
+            loss = lax.pmean(loss, ax)
+            new_state = _pmean_float_leaves(new_state, ax)
+            aux = _pmean_float_leaves(aux, ax)
         new_state = _pmean_float_leaves(new_state, axis_name)
         aux = _pmean_float_leaves(aux, axis_name)
         params, opt_state = optimizer.update(params, grads, opt_state)
@@ -224,7 +241,11 @@ def make_stateful_train_step(
     mapped = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(axis_name), P()),
+        in_specs=(
+            P(), P(), P(),
+            batch_spec if batch_spec is not None else P(axis_name),
+            P(),
+        ),
         out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
@@ -272,10 +293,14 @@ def make_train_step_auto(
     )
 
 
-def shard_batch(batch: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
+def shard_batch(
+    batch: Any, mesh: Mesh, axis_name: str = DATA_AXIS, *, spec=None
+) -> Any:
     """Place a host batch on the mesh, sharded over its leading axis —
-    the device-side analog of handing each process its partition."""
-    sharding = NamedSharding(mesh, P(axis_name))
+    the device-side analog of handing each process its partition.
+    ``spec`` overrides the default ``P(axis_name)`` (e.g.
+    ``P('data', 'model')`` for sequence-sharded token windows)."""
+    sharding = NamedSharding(mesh, spec if spec is not None else P(axis_name))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
 
 

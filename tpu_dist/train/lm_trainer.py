@@ -47,6 +47,16 @@ class LMTrainConfig:
     # exclusive with fsdp; same sharded checkpoint format and
     # accum_steps restriction.
     zero1: bool = False
+    # Tensor parallelism over a 2-D (data x model) mesh: "psum" = the
+    # classic Megatron layout (replicated activations, two psums per
+    # block, vocab-parallel head — loss_tensor_parallel); "sp" = the
+    # Megatron-SP collective-matmul layout (activations sequence-sharded
+    # between sublayers, all-gathers/reduce-scatters folded into the
+    # matmuls — loss_tensor_parallel_sp).  Params stay replicated either
+    # way, so checkpoints/eval/generate are unchanged.  Mutually
+    # exclusive with fsdp/zero1.
+    tensor_parallel: str | None = None
+    model_axis: str = "model"
     log: Callable[[str], None] = print
 
 
@@ -82,6 +92,23 @@ class LMTrainer:
             raise ValueError("fsdp and zero1 are mutually exclusive")
         if self._sharded_mode and self.config.accum_steps != 1:
             raise ValueError("accum_steps > 1 is not supported with fsdp/zero1")
+        tp = self.config.tensor_parallel
+        if tp is not None:
+            if tp not in ("psum", "sp"):
+                raise ValueError(
+                    f"tensor_parallel must be 'psum' or 'sp', got {tp!r}"
+                )
+            if self._sharded_mode:
+                raise ValueError(
+                    "tensor_parallel is not combinable with fsdp/zero1 "
+                    "here (compose via parallel.make_fsdp_train_step's "
+                    "grad_pmean_axes instead)"
+                )
+            if self.config.model_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"tensor_parallel needs a {self.config.model_axis!r} "
+                    f"mesh axis; mesh has {mesh.axis_names}"
+                )
         params, _ = lm.init(jax.random.key(self.config.seed))
         from tpu_dist.utils.debug import assert_no_aliasing
 
@@ -103,6 +130,21 @@ class LMTrainer:
 
         def loss_fn(p, s, batch, key):
             (tokens,) = batch
+            if tp == "sp":
+                # tokens arrive (B/dp, S/tp): batch AND sequence sharded
+                return (
+                    self.lm.loss_tensor_parallel_sp(
+                        cast(p), tokens, self.config.model_axis
+                    ),
+                    ({}, {}),
+                )
+            if tp == "psum":
+                return (
+                    self.lm.loss_tensor_parallel(
+                        cast(p), tokens, self.config.model_axis
+                    ),
+                    ({}, {}),
+                )
             logits, _ = self.lm.apply(cast(p), {}, tokens)
             return lm_loss(logits.astype(jnp.float32), tokens), ({}, {})
 
@@ -133,13 +175,30 @@ class LMTrainer:
 
             self.step = fsdp_step
         else:
+            from jax.sharding import PartitionSpec as P
+
             self.params = parallel.replicate(params, mesh)
             self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
             assert_no_aliasing(self.params, self.opt_state)
             self.step = parallel.make_stateful_train_step(
                 loss_fn, self.optimizer, mesh,
                 accum_steps=self.config.accum_steps,
+                extra_grad_axes=(
+                    (self.config.model_axis,) if tp is not None else ()
+                ),
+                batch_spec=(
+                    P(parallel.DATA_AXIS, self.config.model_axis)
+                    if tp == "sp"
+                    else None
+                ),
             )
+        from jax.sharding import PartitionSpec as _P
+
+        self._batch_spec = (
+            _P(parallel.DATA_AXIS, self.config.model_axis)
+            if tp == "sp"
+            else None
+        )
         self._model_state = parallel.replicate({}, mesh)
 
     def _full_params(self):
@@ -187,7 +246,8 @@ class LMTrainer:
             for b in range(steps_per_epoch):
                 idx = order[b * gb : (b + 1) * gb]
                 batch = parallel.shard_batch(
-                    (jnp.asarray(windows[idx]),), self.mesh
+                    (jnp.asarray(windows[idx]),), self.mesh,
+                    spec=self._batch_spec,
                 )
                 key = jax.random.fold_in(
                     jax.random.fold_in(jax.random.key(cfg.seed + 1), epoch), b
