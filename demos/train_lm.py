@@ -22,6 +22,9 @@ def main():
         bf16=(int, 0, "1 = bfloat16 compute"),
         corpus=(str, "", "UTF-8 text file to train on byte-level "
                          "(default: synthetic Markov corpus)"),
+        tp=(str, "", "tensor parallelism over half the ranks: 'psum' "
+                     "(Megatron) or 'sp' (Megatron-SP collective "
+                     "matmuls); mesh becomes (world/2, 2) data x model"),
     )
     import numpy as np
 
@@ -31,7 +34,14 @@ def main():
     from tpu_dist import comm, data, models, parallel, train
 
     world = args.world or len(comm.devices(args.platform))
-    mesh = comm.make_mesh(world, ("data",), platform=args.platform)
+    if args.tp:
+        if world % 2:
+            raise SystemExit(f"--tp needs an even world, got {world}")
+        mesh = comm.make_mesh(
+            (world // 2, 2), ("data", "model"), platform=args.platform
+        )
+    else:
+        mesh = comm.make_mesh(world, ("data",), platform=args.platform)
     vocab = data.TEXT_VOCAB if args.corpus else 64
     lm = models.TransformerLM(
         vocab=vocab, dim=64, depth=2, heads=4, max_seq=args.seq
@@ -53,10 +63,21 @@ def main():
                 else a,
                 p,
             )
+        if args.tp == "sp":
+            return lm.loss_tensor_parallel_sp(p, tokens, "model"), ({}, {})
+        if args.tp == "psum":
+            return lm.loss_tensor_parallel(p, tokens, "model"), ({}, {})
         logits, _ = lm.apply(p, {}, tokens)
         return models.lm_loss(logits.astype(jnp.float32), tokens), ({}, {})
 
-    step = parallel.make_stateful_train_step(loss_fn, opt, mesh, donate=False)
+    from jax.sharding import PartitionSpec as P
+
+    batch_spec = P("data", "model") if args.tp == "sp" else None
+    step = parallel.make_stateful_train_step(
+        loss_fn, opt, mesh, donate=False,
+        extra_grad_axes=("model",) if args.tp else (),
+        batch_spec=batch_spec,
+    )
     p = parallel.replicate(params, mesh)
     ms = parallel.replicate({}, mesh)
     os_ = parallel.replicate(opt.init(params), mesh)
@@ -73,17 +94,21 @@ def main():
 
         def batch_at(i):
             idx = rng.integers(0, len(windows), size=args.batch)
-            return parallel.shard_batch((jnp.asarray(windows[idx]),), mesh)
+            return parallel.shard_batch(
+                (jnp.asarray(windows[idx]),), mesh, spec=batch_spec
+            )
     else:
         tokens = models.synthetic_tokens(args.batch, args.seq, 64)
-        fixed = parallel.shard_batch((tokens,), mesh)
+        fixed = parallel.shard_batch((tokens,), mesh, spec=batch_spec)
         source = "synthetic Markov corpus"
 
         def batch_at(i):
             return fixed
 
+    layout = f" tp={args.tp}" if args.tp else ""
     print(f"TransformerLM on {world} ranks [{mesh.devices.flat[0].platform}]"
-          f"{' bf16' if compute else ''}: {args.steps} steps on {source}")
+          f"{' bf16' if compute else ''}{layout}: {args.steps} steps on "
+          f"{source}")
     t0 = time.perf_counter()
     for i in range(args.steps):
         p, ms, os_, loss, _ = step(p, ms, os_, batch_at(i), jax.random.key(i))

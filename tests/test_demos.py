@@ -88,3 +88,19 @@ def test_serve_demo_served_equals_live():
         .split(":")[1].split("(")[0]
     )
     assert acc >= 0.9, out
+
+
+def test_train_lm_tensor_parallel_cli():
+    """--tp sp runs the Megatron-SP layout on a (world/2, 2) mesh from
+    the demo CLI; loss must fall like the data-parallel run."""
+    out = run_demo(
+        "train_lm.py", "--world", "4", "--platform", "cpu",
+        "--steps", "16", "--batch", "16", "--seq", "32", "--tp", "sp",
+        timeout=400,
+    )
+    assert "tp=sp" in out
+    losses = [
+        float(l.rsplit("loss", 1)[1])
+        for l in out.splitlines() if l.lstrip().startswith("step")
+    ]
+    assert len(losses) > 2 and losses[-1] < losses[0], out
