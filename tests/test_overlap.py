@@ -341,3 +341,48 @@ def test_collective_matmul_fuzz(world, dtype):
         np.asarray(rs, np.float32), np.asarray(rs_ref, np.float32),
         rtol=tol, atol=tol,
     )
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_bidirectional_rings_match_unidirectional(world):
+    """Splitting each chunk across both ring directions (torus links
+    carry both ways at once) must be a pure scheduling change."""
+    rows_l, d, f = 4, 6, 10
+    x = jax.random.normal(jax.random.key(17), (world * rows_l, d))
+    w = jax.random.normal(jax.random.key(18), (d, f))
+
+    def fn(xc, w):
+        mine = xc[lax.axis_index(AX)]
+        ag_uni = parallel.allgather_matmul(mine, w, AX)
+        ag_bi = parallel.allgather_matmul(mine, w, AX, bidirectional=True)
+        full = lax.all_gather(mine, AX, axis=0, tiled=True)
+        rs_uni = parallel.matmul_reduce_scatter(full, w, AX)
+        rs_bi = parallel.matmul_reduce_scatter(
+            full, w, AX, bidirectional=True
+        )
+        return ag_uni, ag_bi, rs_uni, rs_bi
+
+    xc = jnp.stack(jnp.split(x, world, axis=0))
+    ag_uni, ag_bi, rs_uni, rs_bi = run(fn, xc, w, world=world)
+    np.testing.assert_allclose(
+        np.asarray(ag_bi), np.asarray(ag_uni), rtol=1e-6, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(rs_bi), np.asarray(rs_uni), rtol=1e-5, atol=1e-5
+    )
+    # and both against the dense product
+    np.testing.assert_allclose(
+        np.asarray(ag_bi)[0], np.asarray(x @ w), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_bidirectional_odd_rows_raise():
+    with pytest.raises(ValueError, match="even rows"):
+        run(
+            lambda x, w: parallel.allgather_matmul(
+                x, w, AX, bidirectional=True
+            ),
+            jnp.ones((3, 4)),
+            jnp.ones((4, 4)),
+            world=2,
+        )

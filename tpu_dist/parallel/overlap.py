@@ -45,7 +45,11 @@ from tpu_dist.parallel.tensor_parallel import MODEL_AXIS, shard_dim
 
 
 def allgather_matmul(
-    x_shard: jax.Array, w: jax.Array, axis_name: str = MODEL_AXIS
+    x_shard: jax.Array,
+    w: jax.Array,
+    axis_name: str = MODEL_AXIS,
+    *,
+    bidirectional: bool = False,
 ) -> jax.Array:
     """``all_gather(x_shard, tiled) @ w`` with the gather decomposed into
     a ppermute ring overlapped with per-chunk matmuls.
@@ -59,17 +63,48 @@ def allgather_matmul(
     hopped i times) into its output slot while the ring forwards it on —
     the matmul for hop i and the permute for hop i+1 have no data
     dependence, which is what lets the scheduler overlap them.
+
+    ``bidirectional=True`` splits each chunk's rows in half and sends one
+    half around each ring direction: a physical torus link carries both
+    directions at once, so each hop ships half the bytes in the same
+    wall-clock — ~2x effective gather bandwidth (same total traffic; on
+    the CPU-sim mesh it is merely equivalent).  Requires even rows.
     """
     n = lax.axis_size(axis_name)
     if n == 1:
         return x_shard @ w
+    if bidirectional:
+        rows_l = x_shard.shape[0]
+        if rows_l % 2:
+            raise ValueError(
+                f"bidirectional needs even rows per rank, got {rows_l}"
+            )
+        h = rows_l // 2
+        right = _allgather_matmul_dir(x_shard[:h], w, axis_name, +1)
+        left = _allgather_matmul_dir(x_shard[h:], w, axis_name, -1)
+        # interleave: global rows = [chunk0 top, chunk0 bottom, chunk1 ...]
+        f = w.shape[1]
+        return jnp.concatenate(
+            [right.reshape(n, h, f), left.reshape(n, h, f)], axis=1
+        ).reshape(n * rows_l, f)
+    return _allgather_matmul_dir(x_shard, w, axis_name, +1)
+
+
+def _allgather_matmul_dir(x_shard, w, axis_name, direction):
+    n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     rows_l = x_shard.shape[0]
-    perm = _ring_perm(n)
+    perm = (
+        _ring_perm(n)
+        if direction > 0
+        else [(i, (i - 1) % n) for i in range(n)]
+    )
     out = jnp.zeros((n * rows_l, w.shape[1]), jnp.result_type(x_shard, w))
     chunk = x_shard
     for i in range(n):
-        src = (r - i) % n  # originating rank of the resident chunk
+        # send-right rings hold the chunk from rank r-i after i hops;
+        # send-left rings the chunk from rank r+i
+        src = (r - direction * i) % n
         out = lax.dynamic_update_slice_in_dim(
             out, (chunk @ w).astype(out.dtype), src * rows_l, 0
         )
@@ -79,7 +114,11 @@ def allgather_matmul(
 
 
 def matmul_reduce_scatter(
-    x: jax.Array, w: jax.Array, axis_name: str = MODEL_AXIS
+    x: jax.Array,
+    w: jax.Array,
+    axis_name: str = MODEL_AXIS,
+    *,
+    bidirectional: bool = False,
 ) -> jax.Array:
     """``psum_scatter(x @ w)`` over row chunks, with the ring reduction
     overlapped with the per-chunk matmuls.
@@ -93,24 +132,52 @@ def matmul_reduce_scatter(
     collecting one rank's chunk-matmul per hop; the owner contributes
     last, so after n-1 hops rank r holds exactly chunk r.  Each hop's
     permute is independent of the matmul for the incoming chunk.
+
+    ``bidirectional=True`` halves each traveling accumulator: the top
+    half-rows of every chunk reduce around the left ring, the bottom
+    half around the right — both torus directions carry at once (~2x
+    effective reduction bandwidth; same math).  Requires even rows/n.
     """
     n = lax.axis_size(axis_name)
     if n == 1:
         return x @ w
-    r = lax.axis_index(axis_name)
     rows = x.shape[0]
     if rows % n:
         raise ValueError(f"rows {rows} not divisible by axis size {n}")
     rows_l = rows // n
-    send_left = [(i, (i - 1) % n) for i in range(n)]
+    if bidirectional:
+        if rows_l % 2:
+            raise ValueError(
+                f"bidirectional needs even rows per chunk, got {rows_l}"
+            )
+        h = rows_l // 2
+        top = _mrs_dir(x, w, axis_name, -1, offset=0, size=h)
+        bot = _mrs_dir(x, w, axis_name, +1, offset=h, size=h)
+        return jnp.concatenate([top, bot], axis=0)
+    return _mrs_dir(x, w, axis_name, -1, offset=0, size=rows_l)
+
+
+def _mrs_dir(x, w, axis_name, direction, *, offset, size):
+    """One reduction ring: ``direction=-1`` sends accumulators left
+    (chunk c seeded at rank c+1), ``+1`` sends right (seeded at c-1);
+    either way the owner adds last.  ``offset/size`` select the row
+    window of each chunk this ring carries."""
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    rows_l = x.shape[0] // n
+    perm = (
+        _ring_perm(n)
+        if direction > 0
+        else [(i, (i - 1) % n) for i in range(n)]
+    )
 
     def partial(c):
-        return lax.dynamic_slice_in_dim(x, c * rows_l, rows_l, 0) @ w
+        return lax.dynamic_slice_in_dim(x, c * rows_l + offset, size, 0) @ w
 
-    acc = partial((r + 1) % n)
+    acc = partial((r - direction) % n)
     for i in range(1, n):
-        acc = lax.ppermute(acc, axis_name, send_left)
-        acc = acc + partial((r + 1 + i) % n)
+        acc = lax.ppermute(acc, axis_name, perm)
+        acc = acc + partial((r - direction * (1 + i)) % n)
     return acc
 
 
