@@ -78,6 +78,20 @@ def main():
     def mlp_overlapped(x, params):
         return parallel.tp_mlp_overlapped(x, params, axis)
 
+    def mlp_overlapped_bidir(x, params):
+        # same layout with both ring directions carrying half-chunks
+        w1 = shard_dim(params["fc1"]["w"], axis, 1)
+        b1 = shard_dim(params["fc1"]["b"], axis, 0)
+        w2 = shard_dim(params["fc2"]["w"], axis, 0)
+        x2d = x.reshape(-1, x.shape[-1])
+        hdn = jax.nn.gelu(
+            parallel.allgather_matmul(x2d, w1, axis, bidirectional=True) + b1
+        )
+        out = parallel.matmul_reduce_scatter(
+            hdn, w2, axis, bidirectional=True
+        )
+        return (out + params["fc2"]["b"]).reshape(x.shape[:-1] + (-1,))
+
     def build(fn):
         return jax.jit(
             jax.shard_map(
@@ -119,6 +133,7 @@ def main():
         NamedSharding(mesh, P(axis)),
     )
     blocking, overlapped = build(mlp_blocking), build(mlp_overlapped)
+    overlapped_bidir = build(mlp_overlapped_bidir)
     a, b = np.asarray(blocking(xs, p_repl)), np.asarray(overlapped(xs, p_repl))
     tol = 2e-2 if dtype == jnp.bfloat16 else 1e-4
     if not np.allclose(a.astype(np.float32), b.astype(np.float32), rtol=tol, atol=tol):
@@ -145,7 +160,11 @@ def main():
         # per-chip flops: full MLP is 4*S*d*h over n chips
         flops = 4 * s_l * args.dim * args.hidden
         row = {"seq_per_rank": s_l}
-        for name, fn in (("blocking", blocking), ("overlapped", overlapped)):
+        for name, fn in (
+            ("blocking", blocking),
+            ("overlapped", overlapped),
+            ("overlapped_bidir", overlapped_bidir),
+        ):
             # chained shape-preserving steps closed by a host readback
             # (bench_chain methodology; see utils/timing.py)
             @jax.jit
@@ -164,12 +183,16 @@ def main():
             row[name + "_ms"] = round(per_step * 1e3, 4)
             row[name + "_tflops"] = round(flops / per_step / 1e12, 2)
         row["speedup"] = round(row["blocking_ms"] / row["overlapped_ms"], 3)
+        row["speedup_bidir"] = round(
+            row["blocking_ms"] / row["overlapped_bidir_ms"], 3
+        )
         results["rows"].append(row)
         print(
             f"s/rank={s_l:6d}: blocking {row['blocking_ms']:9.3f} ms "
             f"({row['blocking_tflops']:6.2f} TF/s/chip)  overlapped "
             f"{row['overlapped_ms']:9.3f} ms ({row['overlapped_tflops']:6.2f} "
-            f"TF/s/chip)  speedup x{row['speedup']}",
+            f"TF/s/chip, x{row['speedup']})  bidir "
+            f"{row['overlapped_bidir_ms']:9.3f} ms (x{row['speedup_bidir']})",
             file=sys.stderr,
         )
 
