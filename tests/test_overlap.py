@@ -386,3 +386,29 @@ def test_bidirectional_odd_rows_raise():
             jnp.ones((4, 4)),
             world=2,
         )
+
+
+def test_sp_block_bidirectional_matches_dense():
+    """The Megatron-SP block with both ring directions active is the
+    same function — pure scheduling."""
+    from tpu_dist.models.vit import EncoderBlock
+
+    world, b, s_l, d, heads = 4, 2, 4, 16, 4
+    block = EncoderBlock(d, heads, causal=True)
+    params, _ = block.init(jax.random.key(0), (world * s_l, d))
+    x = jax.random.normal(jax.random.key(1), (b, world * s_l, d))
+    dense, _ = block.apply(params, {}, x, train=False)
+
+    def fn(xc, params):
+        mine = xc[lax.axis_index(AX)]
+        out = parallel.tp_encoder_block_sp(
+            block, params, mine, AX, bidirectional=True
+        )
+        return lax.all_gather(out, AX, axis=1, tiled=True)
+
+    xc = jnp.stack(jnp.split(x, world, axis=1))
+    out = np.asarray(run(fn, xc, params, world=world))
+    for r in range(world):
+        np.testing.assert_allclose(
+            out[r], np.asarray(dense), rtol=1e-4, atol=1e-4
+        )

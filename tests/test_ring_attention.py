@@ -349,3 +349,30 @@ def test_lm_seq_parallel_flash_matches_dense():
         np.testing.assert_allclose(
             out[r], np.asarray(dense), rtol=2e-4, atol=2e-4
         )
+
+
+def test_lm_seq_parallel_ulysses_matches_dense():
+    """apply_seq_parallel(attention='ulysses') — the all-to-all SP
+    strategy at whole-LM level — reproduces the dense forward."""
+    from tests.conftest import spmd_run as run
+    from tpu_dist import comm, models
+
+    world, b, s_l = 4, 2, 8
+    lm = models.TransformerLM(vocab=32, dim=16, depth=1, heads=4, max_seq=32)
+    params, _ = lm.init(jax.random.key(0))
+    tokens = models.synthetic_tokens(b, world * s_l, 32, seed=4)
+    dense, _ = lm.apply(params, {}, tokens)
+
+    def fn(tc, params):
+        mine = tc[lax.axis_index(comm.DEFAULT_AXIS)]
+        local = lm.apply_seq_parallel(
+            params, mine, comm.DEFAULT_AXIS, attention="ulysses"
+        )
+        return lax.all_gather(local, comm.DEFAULT_AXIS, axis=1, tiled=True)
+
+    tc = jnp.stack(jnp.split(tokens, world, axis=1))
+    out = np.asarray(run(fn, tc, params, world=world))
+    for r in range(world):
+        np.testing.assert_allclose(
+            out[r], np.asarray(dense), rtol=2e-4, atol=2e-4
+        )

@@ -521,7 +521,8 @@ class TransformerLM(Module):
         return h @ params["embed"]["table"].T
 
     def apply_seq_parallel(self, params, tokens_local, axis_name, *,
-                           flash: bool = False, interpret: bool = False):
+                           flash: bool = False, interpret: bool = False,
+                           attention: str = "ring"):
         """Sequence-parallel forward for use INSIDE shard_map: tokens are
         the local sequence shard; attention runs as a ppermute ring over
         ``axis_name``; everything else is token-local.  Same params as
@@ -530,7 +531,12 @@ class TransformerLM(Module):
         ``flash=True`` computes each ring block with the Pallas flash
         kernel (`parallel.ring_attention_flash`) — same numbers, no
         per-block (s_local, s_local) score materialization; ``interpret``
-        runs the kernel in interpret mode (CPU-sim testing)."""
+        runs the kernel in interpret mode (CPU-sim testing).
+        ``attention="ulysses"`` swaps the ring core for the all-to-all
+        head-resharding strategy (`parallel.ulysses_attention`; needs
+        ``heads % world == 0``) — pick by topology: the ring hides
+        communication behind block matmuls on a torus, Ulysses pays two
+        all-to-alls but runs full-sequence attention locally."""
         from jax import lax
 
         from tpu_dist.parallel.ring_attention import RingMultiHeadAttention
@@ -555,7 +561,7 @@ class TransformerLM(Module):
         ring_mha = RingMultiHeadAttention(
             self.dim, self.heads, axis_name=axis_name, causal=True,
             use_rope=self.pos_embedding == "rope",
-            use_flash=flash, interpret=interpret,
+            use_flash=flash, interpret=interpret, core=attention,
         )
         for blk, pb in zip(self.blocks, params["blocks"]):
             x1, _ = blk.ln1.apply(pb["ln1"], {}, h)

@@ -187,6 +187,7 @@ def make_fsdp_train_step(
     axis_name: str = DATA_AXIS,
     donate: bool = True,
     grad_pmean_axes: tuple[str, ...] = (),
+    batch_spec=None,
 ):
     """Build the compiled FSDP train step.
 
@@ -206,6 +207,9 @@ def make_fsdp_train_step(
         pass ``('model',)``: per the TP gradient contract
         (test_tensor_parallel.py), the model-axis mean of
         `loss_tensor_parallel` grads equals the dense gradient.
+      batch_spec: PartitionSpec for the batch (default ``P(axis_name)``)
+        — e.g. ``P('data', 'model')`` for the Megatron-SP layout, whose
+        token windows shard over batch AND sequence.
 
     Returns ``(step, sharded_params, opt_state)`` with
     ``step(sharded_params, opt_state, batch, key) -> (sharded_params,
@@ -243,7 +247,11 @@ def make_fsdp_train_step(
     mapped = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(p_specs, o_specs, P(axis_name), P()),
+        in_specs=(
+            p_specs, o_specs,
+            batch_spec if batch_spec is not None else P(axis_name),
+            P(),
+        ),
         out_specs=(p_specs, o_specs, P(), P()),
         check_vma=False,
     )

@@ -188,6 +188,7 @@ def tp_attention_overlapped(
     axis_name: str = MODEL_AXIS,
     *,
     causal: bool = True,
+    bidirectional: bool = False,
 ) -> jax.Array:
     """Sharded-heads attention with SEQUENCE-SHARDED activations: the
     all-gather before the QKV projection and the reduce-scatter after the
@@ -225,7 +226,11 @@ def tp_attention_overlapped(
     ).reshape(3 * hl * hd)
 
     qkv_rows = (
-        allgather_matmul(x_shard.reshape(b * s_l, d), w_loc, axis_name) + b_loc
+        allgather_matmul(
+            x_shard.reshape(b * s_l, d), w_loc, axis_name,
+            bidirectional=bidirectional,
+        )
+        + b_loc
     )  # (n*b*s_l, 3*hl*hd), rank-major chunks = global sequence order
     qkv = qkv_rows.reshape(n, b, s_l, 3, hl, hd)
     # (n, b, s_l, hl, hd) -> (b, hl, S, hd); chunk index n IS the outer
@@ -247,11 +252,16 @@ def tp_attention_overlapped(
     wo_loc = lax.dynamic_slice_in_dim(
         attn_params["out"]["w"], r * hl * hd, hl * hd, 0
     )
-    out = matmul_reduce_scatter(o_rows, wo_loc, axis_name)  # (b*s_l, d)
+    out = matmul_reduce_scatter(
+        o_rows, wo_loc, axis_name, bidirectional=bidirectional
+    )  # (b*s_l, d)
     return out.reshape(b, s_l, d) + attn_params["out"]["b"]
 
 
-def tp_encoder_block_sp(block, params, x_shard, axis_name: str = MODEL_AXIS):
+def tp_encoder_block_sp(
+    block, params, x_shard, axis_name: str = MODEL_AXIS,
+    *, bidirectional: bool = False,
+):
     """A full pre-norm transformer block in the Megatron-SP layout:
     activations stay SEQUENCE-SHARDED between sublayers (1/n of
     `tp_encoder_block`'s activation memory), LayerNorms run token-local
@@ -262,10 +272,12 @@ def tp_encoder_block_sp(block, params, x_shard, axis_name: str = MODEL_AXIS):
     h, _ = block.ln1.apply(params["ln1"], {}, x_shard)
     x = x_shard + tp_attention_overlapped(
         h, params["attn"], block.attn.heads, axis_name,
-        causal=block.attn.causal,
+        causal=block.attn.causal, bidirectional=bidirectional,
     )
     h, _ = block.ln2.apply(params["ln2"], {}, x)
-    return x + tp_mlp_overlapped(h, params["mlp"], axis_name)
+    return x + tp_mlp_overlapped(
+        h, params["mlp"], axis_name, bidirectional=bidirectional
+    )
 
 
 def tp_mlp_overlapped(
@@ -274,6 +286,7 @@ def tp_mlp_overlapped(
     axis_name: str = MODEL_AXIS,
     *,
     activation=jax.nn.gelu,
+    bidirectional: bool = False,
 ) -> jax.Array:
     """The sequence-parallel Megatron MLP with both collectives folded
     into their matmuls: ``activation(AG(x) @ W1 + b1) @ W2 -> RS``.
@@ -294,6 +307,14 @@ def tp_mlp_overlapped(
 
     lead = x_shard.shape[:-1]
     x2d = x_shard.reshape(-1, x_shard.shape[-1])
-    hidden = activation(allgather_matmul(x2d, w1, axis_name) + b1)
-    out = matmul_reduce_scatter(hidden, w2, axis_name) + b2
+    hidden = activation(
+        allgather_matmul(x2d, w1, axis_name, bidirectional=bidirectional)
+        + b1
+    )
+    out = (
+        matmul_reduce_scatter(
+            hidden, w2, axis_name, bidirectional=bidirectional
+        )
+        + b2
+    )
     return out.reshape(*lead, out.shape[-1])

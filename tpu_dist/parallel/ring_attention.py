@@ -233,9 +233,13 @@ class RingMultiHeadAttention:
 
     def __init__(self, dim: int, heads: int, *, axis_name: str,
                  causal: bool = False, use_rope: bool = False,
-                 use_flash: bool = False, interpret: bool = False):
+                 use_flash: bool = False, interpret: bool = False,
+                 core: str = "ring"):
         from tpu_dist import nn  # local import: nn must not depend on parallel
 
+        if core not in ("ring", "ulysses"):
+            raise ValueError(f"core must be 'ring' or 'ulysses', got {core!r}")
+        self.core = core
         self.axis_name = axis_name
         self.causal = causal
         self.use_rope = use_rope
@@ -275,7 +279,16 @@ class RingMultiHeadAttention:
             r = lax.axis_index(self.axis_name)
             pos = r * s_local + jnp.arange(s_local)
             q, k = nn.rope(q, pos), nn.rope(k, pos)
-        if self.use_flash:
+        if self.core == "ulysses":
+            # all-to-all head resharding: full-sequence attention on a
+            # head subset (q/k enter pre-rotated by GLOBAL position, so
+            # rope survives the resharding exactly)
+            from tpu_dist.parallel.ulysses import ulysses_attention
+
+            o = ulysses_attention(
+                q, k, v, self.axis_name, causal=self.causal
+            )
+        elif self.use_flash:
             o = ring_attention_flash(
                 q, k, v, self.axis_name, causal=self.causal,
                 interpret=self.interpret,
