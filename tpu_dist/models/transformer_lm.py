@@ -573,6 +573,183 @@ class TransformerLM(Module):
         h, _ = self.ln.apply(params["ln"], {}, h)
         return h @ params["embed"]["table"].T
 
+    # ---- context-parallel decode (sequence-sharded prompt cache) -------
+
+    def _project_qkv(self, attn_params, x, positions):
+        """Fused-QKV projection + optional rope at GLOBAL ``positions``;
+        x (b, s, d) -> q, k, v each (b, heads, s, head_dim)."""
+        from tpu_dist.nn.attention import rope
+
+        b, s, _ = x.shape
+        hd = self.dim // self.heads
+        qkv = (x @ attn_params["qkv"]["w"] + attn_params["qkv"]["b"]).reshape(
+            b, s, 3, self.heads, hd
+        )
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+        if self.pos_embedding == "rope":
+            q, k = rope(q, positions), rope(k, positions)
+        return q, k, v
+
+    def generate_seq_parallel(
+        self,
+        params,
+        prompt_local,
+        steps: int,
+        axis_name,
+        *,
+        key=None,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+    ):
+        """Decode after a SEQUENCE-SHARDED prompt, for use INSIDE
+        shard_map over ``axis_name`` — context-parallel serving: a
+        prompt too long for one chip's KV cache is prefilled with ring
+        attention and its K/V stay sharded, 1/n per rank, for the whole
+        decode.
+
+        Prefill: `apply_seq_parallel`'s block math, additionally saving
+        each block's LOCAL K/V shard (the distributed prompt cache); the
+        last global position's logits reach every rank with one psum.
+        Decode: each new token is computed replicated; every rank scores
+        it against its prompt-cache shard, and the per-rank partials
+        merge EXACTLY via log-sum-exp (the flash/ring recombination) with
+        a small replicated cache of the generated window.  Every rank
+        samples the same token from the same key.  Token-exact vs the
+        dense `generate` on the gathered prompt (tested; fused-QKV
+        layout, learned or rope positions).
+
+        ``prompt_local``: (b, s_p_local) — rank r holds global positions
+        ``r*s_p_local ..``.  Returns (b, steps) sampled tokens
+        (replicated).
+        """
+        from jax import lax
+
+        if self.kv_heads != self.heads:
+            raise ValueError(
+                "generate_seq_parallel requires kv_heads == heads "
+                "(fused-QKV layout)"
+            )
+        n = lax.axis_size(axis_name)
+        r = lax.axis_index(axis_name)
+        b, s_l = prompt_local.shape
+        S = n * s_l  # global prompt length
+        if S + steps > self.max_seq:
+            raise ValueError(
+                f"prompt {S} + steps {steps} exceeds max_seq {self.max_seq}"
+            )
+        if key is None:
+            key = jax.random.key(0)
+        sample = _make_sampler(temperature, top_k, top_p, prompt_local.dtype)
+        from tpu_dist.parallel.ring_attention import ring_attention
+
+        # --- prefill: ring attention, saving local K/V per block ---
+        h = self._trunk(params, prompt_local, pos_offset=r * s_l)
+        pos_local = r * s_l + jnp.arange(s_l)
+        prompt_cache = []
+        for blk, pb in zip(self.blocks, params["blocks"]):
+            x1, _ = blk.ln1.apply(pb["ln1"], {}, h)
+            q, k, v = self._project_qkv(pb["attn"], x1, pos_local)
+            o = ring_attention(q, k, v, axis_name, causal=True)
+            o = jnp.moveaxis(o, 1, 2).reshape(b, s_l, self.dim)
+            h = h + o @ pb["attn"]["out"]["w"] + pb["attn"]["out"]["b"]
+            x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
+            m, _ = blk.mlp.apply(pb["mlp"], {}, x2)
+            h = h + m
+            prompt_cache.append({"k": k, "v": v})  # (b, heads, s_l, hd)
+        h, _ = self.ln.apply(params["ln"], {}, h)
+        last_local = h[:, -1] @ params["embed"]["table"].T  # (b, V)
+        # the last GLOBAL token lives on rank n-1; one psum replicates it
+        last = lax.psum(
+            jnp.where(r == n - 1, last_local, jnp.zeros_like(last_local)),
+            axis_name,
+        )
+
+        # --- decode: replicated window cache + sharded prompt cache ---
+        hd = self.dim // self.heads
+        dt = params["embed"]["table"].dtype
+        dec_cache = [
+            {
+                "k": jnp.zeros((b, self.heads, steps, hd), dt),
+                "v": jnp.zeros((b, self.heads, steps, hd), dt),
+            }
+            for _ in self.blocks
+        ]
+
+        def decode_one(tok, dec_cache, t):
+            """One replicated token at global position S + t."""
+            pos = S + t
+            hh = self._trunk(params, tok[:, None], pos_offset=pos)
+            new_cache = []
+            for blk, pb, pc, dc in zip(
+                self.blocks, params["blocks"], prompt_cache, dec_cache
+            ):
+                x1, _ = blk.ln1.apply(pb["ln1"], {}, hh)
+                q, k_new, v_new = self._project_qkv(
+                    pb["attn"], x1, pos + jnp.arange(1)
+                )
+                dk = lax.dynamic_update_slice_in_dim(
+                    dc["k"], k_new.astype(dt), t, axis=2
+                )
+                dv = lax.dynamic_update_slice_in_dim(
+                    dc["v"], v_new.astype(dt), t, axis=2
+                )
+                scale = hd**-0.5
+                qs = (q * scale).astype(jnp.float32)
+                # partial attention over this rank's prompt shard
+                lg_p = jnp.einsum(
+                    "bhqd,bhkd->bhqk", qs, pc["k"].astype(jnp.float32)
+                )
+                m_p = lg_p.max(-1)
+                p_p = jnp.exp(lg_p - m_p[..., None])
+                l_p = p_p.sum(-1)
+                out_p = jnp.einsum(
+                    "bhqk,bhkd->bhqd", p_p, pc["v"].astype(jnp.float32)
+                ) / l_p[..., None]
+                lse_p = m_p + jnp.log(l_p)
+                # replicated decode window (positions < t+1 valid)
+                lg_d = jnp.einsum(
+                    "bhqd,bhkd->bhqk", qs, dk.astype(jnp.float32)
+                )
+                valid = (jnp.arange(dk.shape[2]) <= t)[None, None, None, :]
+                lg_d = jnp.where(valid, lg_d, -1e30)
+                m_d = lg_d.max(-1)
+                p_d = jnp.exp(lg_d - m_d[..., None])
+                p_d = jnp.where(valid, p_d, 0.0)
+                l_d = p_d.sum(-1)
+                out_d = jnp.einsum(
+                    "bhqk,bhkd->bhqd", p_d, dv.astype(jnp.float32)
+                ) / jnp.maximum(l_d, 1e-30)[..., None]
+                lse_d = m_d + jnp.log(jnp.maximum(l_d, 1e-30))
+                # exact merge: psum the prompt partials, add the decode
+                # part ONCE (it is identical on every rank)
+                m_star = jnp.maximum(lax.pmax(lse_p, axis_name), lse_d)
+                w_p = jnp.exp(lse_p - m_star)
+                w_d = jnp.exp(lse_d - m_star)
+                num = lax.psum(w_p[..., None] * out_p, axis_name) + (
+                    w_d[..., None] * out_d
+                )
+                den = lax.psum(w_p, axis_name) + w_d
+                o = (num / den[..., None]).astype(hh.dtype)
+                o = jnp.moveaxis(o, 1, 2).reshape(b, 1, self.dim)
+                hh = hh + o @ pb["attn"]["out"]["w"] + pb["attn"]["out"]["b"]
+                x2, _ = blk.ln2.apply(pb["ln2"], {}, hh)
+                mm, _ = blk.mlp.apply(pb["mlp"], {}, x2)
+                hh = hh + mm
+                new_cache.append({"k": dk, "v": dv})
+            hh, _ = self.ln.apply(params["ln"], {}, hh)
+            return hh[:, 0] @ params["embed"]["table"].T, new_cache
+
+        def body(carry, kk):
+            dec_cache, last, t = carry
+            tok = sample(last, kk)
+            logits, dec_cache = decode_one(tok, dec_cache, t)
+            return (dec_cache, logits, t + 1), tok
+
+        keys = jax.random.split(key, steps)
+        _, toks = lax.scan(body, (dec_cache, last, jnp.int32(0)), keys)
+        return jnp.moveaxis(toks, 0, 1)
+
 
 def lm_loss(
     logits: jax.Array, tokens: jax.Array, *, mask: jax.Array | None = None
