@@ -7,12 +7,16 @@
   TPU-only, ppermute fallback elsewhere.
 """
 
-from tpu_dist.ops.flash_attention import flash_attention
+from tpu_dist.ops.flash_attention import (
+    flash_attention,
+    flash_attention_lse,
+)
 from tpu_dist.ops.matmul import matmul, use_pallas_dense
 from tpu_dist.ops.pallas_ring import ring_all_reduce_pallas
 
 __all__ = [
     "flash_attention",
+    "flash_attention_lse",
     "matmul",
     "ring_all_reduce_pallas",
     "use_pallas_dense",
