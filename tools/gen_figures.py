@@ -151,6 +151,50 @@ def ring_figure():
     return fig
 
 
+def collective_matmul_figure():
+    """Timeline: blocking all_gather->matmul vs the ppermute ring whose
+    hops overlap the chunk matmuls (parallel/overlap.py)."""
+    fig, ax = plt.subplots(figsize=(7.6, 3.4))
+    ax.set_xlim(0, 10.4)
+    ax.set_ylim(-0.4, 3.4)
+    ax.axis("off")
+    ax.set_title(
+        "collective matmul: gather hops ride ICI while the MXU multiplies",
+        fontsize=11, color=INK, family="monospace", pad=10,
+    )
+
+    def bar(x, y, w, label, *, accent=False):
+        ax.add_patch(
+            FancyBboxPatch(
+                (x, y), w, 0.5, boxstyle="round,pad=0.03",
+                facecolor="#fbeee9" if accent else BOX,
+                edgecolor=ACCENT if accent else EDGE, linewidth=1.0,
+            )
+        )
+        ax.text(
+            x + w / 2, y + 0.25, label, ha="center", va="center",
+            fontsize=8.5, family="monospace", color=INK,
+        )
+
+    ax.text(0.05, 2.95, "blocking:", fontsize=9.5, family="monospace",
+            color=INK)
+    bar(1.7, 2.7, 3.0, "all_gather (idle MXU)", accent=True)
+    bar(4.8, 2.7, 4.4, "matmul  x_full @ w")
+    ax.text(0.05, 1.75, "overlapped:", fontsize=9.5, family="monospace",
+            color=INK)
+    for i in range(4):
+        bar(1.7 + 1.9 * i, 1.5, 1.8, f"chunk{i} @ w")
+    for i in range(3):
+        bar(2.3 + 1.9 * i, 0.7, 1.6, f"hop {i + 1}", accent=True)
+    ax.text(
+        5.2, 0.15,
+        "ppermute of chunk i+1 is independent of matmul i -> scheduler "
+        "hides it",
+        ha="center", fontsize=8.5, color="#777777", family="monospace",
+    )
+    return fig
+
+
 def main():
     out = Path(__file__).parent.parent / "docs" / "figs"
     out.mkdir(parents=True, exist_ok=True)
@@ -230,6 +274,7 @@ def main():
             note="transpose across ranks: slice j of rank i -> slice i of rank j",
         ),
         "ring": ring_figure(),
+        "collective_matmul": collective_matmul_figure(),
     }
     for name, fig in figs.items():
         path = out / f"{name}.svg"
