@@ -32,15 +32,23 @@ def main():
     ap.add_argument("--steps", type=int, default=128)
     ap.add_argument("--max-seq", type=int, default=512)
     ap.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
+    ap.add_argument(
+        "--mode", default="dense", choices=["dense", "tp", "cp"],
+        help="dense = single-program decode; tp = sharded-heads decode "
+        "(generate_tensor_parallel); cp = context-parallel decode "
+        "(generate_seq_parallel, prompt KV sequence-sharded).  tp/cp "
+        "need >=2 devices on one ICI domain to mean anything.",
+    )
     args = ap.parse_args()
+    n_sim = 8 if args.mode != "dense" else None  # sharded smoke needs a mesh
     if args.platform == "cpu":
         from tpu_dist.utils.platform import pin_cpu
 
-        pin_cpu()
+        pin_cpu(n_sim)
     elif args.platform is None:
         from tpu_dist.utils.platform import pin_cpu_if_backend_dead
 
-        pin_cpu_if_backend_dead()
+        pin_cpu_if_backend_dead(n_sim)
 
     import jax
 
@@ -68,7 +76,39 @@ def main():
         )
         from tpu_dist.utils.platform import host_sync
 
-        gen = jax.jit(functools.partial(lm.generate, steps=args.steps))
+        if args.mode == "dense":
+            gen = jax.jit(functools.partial(lm.generate, steps=args.steps))
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from tpu_dist import comm
+
+            world = len(jax.devices())
+            axis = "model" if args.mode == "tp" else "seq"
+            mesh = comm.make_mesh(world, (axis,))
+            if args.mode == "cp" and args.prompt % world:
+                raise SystemExit(
+                    f"--mode cp needs prompt {args.prompt} divisible by "
+                    f"world {world}"
+                )
+            body = (
+                (lambda p, t: lm.generate_tensor_parallel(
+                    p, t, args.steps, axis))
+                if args.mode == "tp"
+                else (lambda p, t: lm.generate_seq_parallel(
+                    p, t, args.steps, axis))
+            )
+            prompt_spec = P() if args.mode == "tp" else P(None, axis)
+            mapped = jax.shard_map(
+                body, mesh=mesh, in_specs=(P(), prompt_spec),
+                out_specs=P(), check_vma=False,
+            )
+
+            def gen(params, prm, _m=mapped, _mesh=mesh, _ps=prompt_spec):
+                return jax.jit(_m)(
+                    jax.device_put(params, NamedSharding(_mesh, P())),
+                    jax.device_put(prm, NamedSharding(_mesh, _ps)),
+                )
         host_sync(gen(params, prompt))  # compile + warm (true completion)
         dt = float("inf")
         for r in range(1, 4):  # distinct prompts: no run can be a cache hit
@@ -121,6 +161,7 @@ def main():
         )
     print(json.dumps({
         "metric": "lm_decode_tokens_per_sec",
+        "mode": args.mode,
         "platform": dev.platform,
         "model": f"dim{args.dim}xL{args.depth}h{args.heads}",
         "prompt": args.prompt, "steps": args.steps,
