@@ -389,3 +389,21 @@ def test_adamw_decay_mask_spares_biases():
 
     assert train.decay_mask_default("['blocks'][0]['ln1']['scale']", jnp.ones((8,))) is False
     assert train.decay_mask_default("['mlp']['fc1']['w']", jnp.ones((8, 8))) is True
+
+
+def test_trainer_grad_reduce_backends_train(mesh, dataset):
+    """TrainConfig(grad_reduce=...) reaches the step builder: 'ring' is
+    trajectory-identical to 'psum'; 'fp8' still learns."""
+
+    def fit_with(backend):
+        cfg = train.TrainConfig(
+            epochs=1, log=lambda s: None, grad_reduce=backend
+        )
+        t = train.Trainer(models.mnist_net(), models.IN_SHAPE, mesh, cfg)
+        return t.fit(dataset)[-1].mean_loss
+
+    psum = fit_with("psum")
+    ring = fit_with("ring")
+    fp8 = fit_with("fp8")
+    assert ring == pytest.approx(psum, rel=1e-5)
+    assert np.isfinite(fp8)
