@@ -155,6 +155,31 @@ def main():
             file=sys.stderr,
         )
 
+    if args.tune and not interpret:
+        # Persist the winners so `ops.matmul` re-tunes its defaults from
+        # measured data on this device kind (committed by the battery).
+        from pathlib import Path
+
+        tuned = {
+            f"{r['n']}x{r['n']}x{r['n']}": r["tuned_blocks"]
+            for r in results["matmul"]
+            if "tuned_blocks" in r
+        }
+        if tuned:
+            kind = dev.device_kind.replace(" ", "_").replace("/", "_")
+            path = (
+                Path(__file__).parent / "results"
+                / f"tuned_blocks_{kind}.json"
+            )
+            try:
+                existing = json.loads(path.read_text())
+            except (OSError, ValueError):
+                existing = {}
+            existing.update(tuned)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(json.dumps(existing, indent=1))
+            print(f"tuned table -> {path}", file=sys.stderr)
+
     # ---- flash attention vs dense XLA attention, fwd and fwd+bwd ----
     for S in args.seqs:
         kq, kk, kv, key = jax.random.split(key, 4)

@@ -273,3 +273,35 @@ def test_explicit_nondividing_block_skips_useless_padding():
         jax.make_jaxpr(lambda a, b: mm.matmul(a, b, bm=500, interpret=True))(x, w)
     )
     assert "pad" not in jaxpr_explicit
+
+
+def test_tuned_block_table_overrides_heuristic(tmp_path, monkeypatch):
+    """A measured tuned-blocks table (kernels.py --tune output) wins over
+    the _auto_blocks heuristic for its exact shapes; other shapes and
+    explicit args are untouched."""
+    import importlib
+    import json as _json
+
+    mm = importlib.import_module("tpu_dist.ops.matmul")
+    table = tmp_path / "tuned.json"
+    table.write_text(_json.dumps({"512x512x512": [128, 128, 256]}))
+    monkeypatch.setenv("TPU_DIST_TUNED_BLOCKS", str(table))
+    monkeypatch.setattr(mm, "_TUNED_CACHE", None)  # force reload
+    assert mm._resolve_blocks(512, 512, 512, None, None, None) == (
+        128, 128, 256,
+    )
+    # explicit arg beats the table
+    assert mm._resolve_blocks(512, 512, 512, 256, None, None)[0] == 256
+    # unknown shape falls back to the heuristic
+    assert mm._resolve_blocks(256, 256, 256, None, None, None) == (
+        mm._auto_blocks(256, 256, 256)
+    )
+    # correctness through the kernel with the tuned pick
+    x = jax.random.normal(jax.random.key(30), (512, 512))
+    w = jax.random.normal(jax.random.key(31), (512, 512))
+    out = mm.matmul(x, w, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(x @ w), rtol=1e-4, atol=1e-4
+    )
+    monkeypatch.setattr(mm, "_TUNED_CACHE", None)  # don't leak to others
+    monkeypatch.delenv("TPU_DIST_TUNED_BLOCKS")

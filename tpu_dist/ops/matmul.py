@@ -57,6 +57,58 @@ def _matmul_kernel(x_ref, w_ref, b_ref, o_ref, acc_ref, *, epilogue: str, nk: in
 
 _VMEM_BUDGET = 8 * 1024 * 1024  # ~half of a core's ~16MB VMEM
 
+_TUNED_CACHE: dict | None = None
+
+
+def _tuned_table() -> dict:
+    """Measured block winners from ``benchmarks/kernels.py --tune``,
+    keyed "MxNxK" per device kind.  Looked up before the `_auto_blocks`
+    heuristic so a committed hardware sweep re-tunes the defaults from
+    data (the profile -> iterate loop).  Source: the path in
+    ``TPU_DIST_TUNED_BLOCKS``, else
+    ``benchmarks/results/tuned_blocks_<device_kind>.json`` in the repo;
+    absent/unreadable -> empty (heuristic only)."""
+    global _TUNED_CACHE
+    if _TUNED_CACHE is not None:
+        return _TUNED_CACHE
+    import json
+    from pathlib import Path
+
+    path = os.environ.get("TPU_DIST_TUNED_BLOCKS")
+    if not path:
+        try:
+            import jax
+
+            kind = (
+                jax.devices()[0].device_kind.replace(" ", "_").replace("/", "_")
+            )
+        except Exception:
+            kind = "unknown"
+        path = str(
+            Path(__file__).resolve().parents[2]
+            / "benchmarks" / "results" / f"tuned_blocks_{kind}.json"
+        )
+    try:
+        _TUNED_CACHE = {
+            key: tuple(int(b) for b in blocks)
+            for key, blocks in json.loads(Path(path).read_text()).items()
+        }
+    except (OSError, ValueError):
+        _TUNED_CACHE = {}
+    return _TUNED_CACHE
+
+
+def _resolve_blocks(
+    m: int, n: int, k: int, bm, bn, bk
+) -> tuple[int, int, int]:
+    """Final block sizes: explicit args win, then a measured tuned-table
+    entry for this exact shape, then the `_auto_blocks` heuristic."""
+    if bm is None or bn is None or bk is None:
+        tuned = _tuned_table().get(f"{m}x{n}x{k}")
+        abm, abn, abk = tuned if tuned is not None else _auto_blocks(m, n, k)
+        bm, bn, bk = bm or abm, bn or abn, bk or abk
+    return bm, bn, bk
+
 
 def _vmem_bytes(bm: int, bn: int, bk: int) -> int:
     """Working set: 2 copies (double buffer) of the input blocks + the
@@ -141,9 +193,7 @@ def _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret):
         b = jnp.pad(b, ((0, 0), (0, pn)))
         out = _matmul_impl(x, w, b, epilogue, bm, bn, bk, interpret)
         return out[:m, :n]
-    if bm is None or bn is None or bk is None:
-        abm, abn, abk = _auto_blocks(m, n, k)
-        bm, bn, bk = bm or abm, bn or abn, bk or abk
+    bm, bn, bk = _resolve_blocks(m, n, k, bm, bn, bk)
     bm_, bn_, bk_ = _pick_block(m, bm), _pick_block(n, bn), _pick_block(k, bk)
     if not interpret and _vmem_bytes(bm_, bn_, bk_) > _VMEM_BUDGET:
         # explicit blocks bypass _auto_blocks' budget loop (and padding
