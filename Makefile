@@ -7,7 +7,7 @@ PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
 .PHONY: test ptp gather allreduce train bench runtime train-image \
-        kernels decode serve lm-train parity figures \
+        kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm generate docs demos
 
 test:
@@ -57,6 +57,9 @@ decode:
 
 lm-train:
 	$(PY) benchmarks/lm_train.py --platform $(PLATFORM)
+
+overlap:
+	$(PY) benchmarks/overlap.py --platform $(PLATFORM)
 
 parity:
 	$(PY) tools/parity_real_data.py --platform $(PLATFORM)
