@@ -535,3 +535,56 @@ class TestContextParallelDecode:
                 params,
                 world=2,
             )
+
+
+class TestBeamSearch:
+    def test_beams_one_equals_greedy(self, lm, lm_params):
+        prompt = models.synthetic_tokens(2, 5, 64, seed=12)
+        greedy = np.asarray(lm.generate(lm_params, prompt, 8))
+        beam1 = np.asarray(
+            lm.generate_beam(lm_params, prompt, 8, beams=1)
+        )
+        np.testing.assert_array_equal(beam1, greedy)
+
+    def test_wider_beam_never_scores_worse(self, lm, lm_params):
+        """The best beam-4 sequence's total log-prob must be >= the
+        greedy sequence's (greedy is in beam search's search space)."""
+        prompt = models.synthetic_tokens(2, 5, 64, seed=13)
+        steps = 8
+
+        def seq_logprob(tokens_out):
+            """Score a continuation under the model (teacher-forced)."""
+            full = jnp.concatenate([prompt, jnp.asarray(tokens_out)], axis=1)
+            logits, _ = lm.apply(lm_params, {}, full)
+            lp = jax.nn.log_softmax(
+                logits[:, prompt.shape[1] - 1 : -1].astype(jnp.float32), -1
+            )
+            picked = jnp.take_along_axis(
+                lp, jnp.asarray(tokens_out)[:, :, None], axis=-1
+            )[..., 0]
+            return np.asarray(picked.sum(axis=1))
+
+        greedy = np.asarray(lm.generate(lm_params, prompt, steps))
+        best = np.asarray(
+            lm.generate_beam(lm_params, prompt, steps, beams=4)
+        )
+        g_lp, b_lp = seq_logprob(greedy), seq_logprob(best)
+        assert (b_lp >= g_lp - 1e-4).all(), (g_lp, b_lp)
+
+    def test_return_all_sorted_and_distinct(self, lm, lm_params):
+        prompt = models.synthetic_tokens(1, 4, 64, seed=14)
+        toks, scores = lm.generate_beam(
+            lm_params, prompt, 6, beams=4, return_all=True
+        )
+        assert toks.shape == (1, 4, 6) and scores.shape == (1, 4)
+        s = np.asarray(scores)[0]
+        assert (np.diff(s) <= 1e-6).all()  # best-first
+        rows = {tuple(r) for r in np.asarray(toks)[0]}
+        assert len(rows) > 1  # beams explored distinct continuations
+
+    def test_beam_is_jittable(self, lm, lm_params):
+        prompt = models.synthetic_tokens(1, 4, 64, seed=15)
+        out = jax.jit(
+            functools.partial(lm.generate_beam, steps=5, beams=3)
+        )(lm_params, prompt)
+        assert out.shape == (1, 5)

@@ -235,6 +235,90 @@ class TransformerLM(Module):
         _, toks = lax.scan(body, (cache, last, jnp.int32(s_p)), keys)
         return jnp.moveaxis(toks, 0, 1)
 
+    def generate_beam(
+        self,
+        params,
+        prompt,
+        steps: int,
+        *,
+        beams: int = 4,
+        cache_len: int | None = None,
+        return_all: bool = False,
+    ):
+        """Beam-search decode: keep the ``beams`` highest-total-log-prob
+        continuations at every step (deterministic; the search analog of
+        `generate`'s sampling).  One prefill on the un-tiled prompt, the
+        cache tiled ``beams``-fold, then a ``lax.scan`` whose carry
+        re-gathers the KV cache and token history under each step's
+        surviving beam indices — still one compiled program.
+
+        No EOS semantics (byte/markov corpora here have none): all beams
+        run exactly ``steps`` tokens, so the total log-prob comparison
+        needs no length normalization.  Returns the best beam's tokens
+        ``(b, steps)`` — or, with ``return_all``, ``(tokens (b, beams,
+        steps), scores (b, beams))`` sorted best-first.  ``beams=1``
+        reproduces greedy `generate` exactly (tested).
+        """
+        from jax import lax
+
+        if beams < 1:
+            raise ValueError(f"beams must be >= 1, got {beams}")
+        b, s_p = prompt.shape
+        L = cache_len or self.max_seq
+        if s_p + steps > L:
+            raise ValueError(
+                f"prompt {s_p} + steps {steps} exceeds cache length {L}"
+            )
+        k = beams
+        cache = self.init_cache(b, L, dtype=params["embed"]["table"].dtype)
+        logits, cache = self.apply_cached(params, prompt, cache, 0)
+        # tile the cache beam-fold: rows [b0 x k, b1 x k, ...]
+        cache = jax.tree.map(lambda c: jnp.repeat(c, k, axis=0), cache)
+        last = jnp.repeat(logits[:, -1], k, axis=0)  # (b*k, V)
+        V = last.shape[-1]
+        # beam 0 live, the rest -inf: step 0 picks k distinct tokens from
+        # beam 0 instead of k copies of the same argmax
+        scores0 = jnp.tile(
+            jnp.concatenate(
+                [jnp.zeros((1,)), jnp.full((k - 1,), -1e30)]
+            )[None, :],
+            (b, 1),
+        )
+        toks0 = jnp.zeros((b, k, steps), prompt.dtype)
+        batch_base = (jnp.arange(b)[:, None] * k)  # (b, 1)
+
+        def body(carry, t):
+            cache, last, scores, toks = carry
+            logp = jax.nn.log_softmax(
+                last.astype(jnp.float32), axis=-1
+            ).reshape(b, k, V)
+            total = scores[:, :, None] + logp  # (b, k, V)
+            top_scores, top_idx = lax.top_k(total.reshape(b, k * V), k)
+            beam_idx = top_idx // V  # (b, k) surviving parent beams
+            tok = (top_idx % V).astype(prompt.dtype)  # (b, k)
+            flat = (batch_base + beam_idx).reshape(-1)  # (b*k,)
+            cache = jax.tree.map(lambda c: c[flat], cache)
+            toks = jnp.take_along_axis(
+                toks, beam_idx[:, :, None], axis=1
+            )
+            toks = lax.dynamic_update_slice_in_dim(
+                toks, tok[:, :, None], t, axis=2
+            )
+            logits, cache = self.apply_cached(
+                params, tok.reshape(b * k, 1), cache, s_p + t
+            )
+            return (cache, logits[:, 0], top_scores, toks), None
+
+        (cache, last, scores, toks), _ = lax.scan(
+            body, (cache, last, scores0, toks0), jnp.arange(steps)
+        )
+        order = jnp.argsort(-scores, axis=1)
+        toks = jnp.take_along_axis(toks, order[:, :, None], axis=1)
+        scores = jnp.take_along_axis(scores, order, axis=1)
+        if return_all:
+            return toks, scores
+        return toks[:, 0]
+
     def apply_tensor_parallel(self, params, tokens, axis_name):
         """Tensor-parallel forward for use INSIDE shard_map over a
         ``model`` axis: attention heads and MLP hidden dims shard across
