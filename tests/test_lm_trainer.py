@@ -122,3 +122,20 @@ def test_tensor_parallel_validations(mesh):
     mesh2d = comm.make_mesh((2, 2), ("data", "model"), platform="cpu")
     with pytest.raises(ValueError, match="not combinable"):
         _trainer(mesh2d, tensor_parallel="sp", fsdp=True)
+
+
+def test_tensor_parallel_bf16_matches_dense_bf16(mesh, windows):
+    """Review fix: the TP loss paths must upcast their softmax to f32
+    like the dense path — under compute_dtype='bfloat16' the TP and DP
+    trajectories still agree."""
+    dense_hist = _trainer(mesh, compute_dtype="bfloat16").fit(
+        windows, epochs=1
+    )
+    mesh2d = comm.make_mesh((2, 2), ("data", "model"), platform="cpu")
+    for layout in ("psum", "sp"):
+        tp_hist = _trainer(
+            mesh2d, tensor_parallel=layout, compute_dtype="bfloat16"
+        ).fit(windows, epochs=1)
+        assert tp_hist[0].mean_loss == pytest.approx(
+            dense_hist[0].mean_loss, rel=2e-2
+        ), layout

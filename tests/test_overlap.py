@@ -412,3 +412,19 @@ def test_sp_block_bidirectional_matches_dense():
         np.testing.assert_allclose(
             out[r], np.asarray(dense), rtol=1e-4, atol=1e-4
         )
+
+
+def test_sp_block_rejects_rope():
+    """Review fix: the SP block does not apply rotary embeddings and must
+    refuse rope-built blocks instead of silently running un-rotated q/k."""
+    from tpu_dist.models.vit import EncoderBlock
+
+    block = EncoderBlock(16, 4, causal=True, use_rope=True)
+    params, _ = block.init(jax.random.key(0), (8, 16))
+    with pytest.raises(ValueError, match="rotary"):
+        run(
+            lambda x, p: parallel.tp_encoder_block_sp(block, p, x, AX),
+            jnp.ones((1, 4, 16)),
+            params,
+            world=2,
+        )

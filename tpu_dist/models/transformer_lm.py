@@ -882,7 +882,9 @@ def lm_loss_seq_parallel(
         first, axis_name, [(i, (i - 1) % n) for i in range(n)]
     )
     targets = jnp.concatenate([tokens_local[:, 1:], from_right], axis=1)
-    logp = jax.nn.log_softmax(logits_local, axis=-1)
+    # f32 like lm_loss: bf16 log-softmax would make the TP trajectory
+    # diverge from the dense one under compute_dtype='bfloat16'
+    logp = jax.nn.log_softmax(logits_local.astype(jnp.float32), axis=-1)
     picked = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     # mask the last global position (rank n-1's last token has no target)
     pos_valid = jnp.where(

@@ -128,10 +128,11 @@ def matmul_reduce_scatter(
     (d_l, f).  Returns (rows / n, f): row chunk r of the full product,
     summed over every rank's partial contribution.
 
-    The accumulator for chunk c starts at rank c+1 and travels left,
-    collecting one rank's chunk-matmul per hop; the owner contributes
-    last, so after n-1 hops rank r holds exactly chunk r.  Each hop's
-    permute is independent of the matmul for the incoming chunk.
+    The accumulator for chunk c is SEEDED at rank c-1 (each rank r seeds
+    chunk r+1) and travels left, collecting one rank's chunk-matmul per
+    hop; the owner contributes last, so after n-1 hops rank r holds
+    exactly chunk r.  Each hop's permute is independent of the matmul
+    for the incoming chunk.
 
     ``bidirectional=True`` halves each traveling accumulator: the top
     half-rows of every chunk reduce around the left ring, the bottom
@@ -159,9 +160,11 @@ def matmul_reduce_scatter(
 
 def _mrs_dir(x, w, axis_name, direction, *, offset, size):
     """One reduction ring: ``direction=-1`` sends accumulators left
-    (chunk c seeded at rank c+1), ``+1`` sends right (seeded at c-1);
-    either way the owner adds last.  ``offset/size`` select the row
-    window of each chunk this ring carries."""
+    (chunk c seeded at rank c-1 — each rank seeds chunk r+1), ``+1``
+    sends right (chunk c seeded at rank c+1 — each rank seeds chunk
+    r-1); either way the owner adds last after n-1 hops.
+    ``offset/size`` select the row window of each chunk this ring
+    carries."""
     n = lax.axis_size(axis_name)
     r = lax.axis_index(axis_name)
     rows_l = x.shape[0] // n
@@ -269,6 +272,11 @@ def tp_encoder_block_sp(
     matmuls (`tp_attention_overlapped` + `tp_mlp_overlapped`).  ``block``
     is the EncoderBlock instance; ``params`` its replicated pytree.
     Numerics match ``block.apply`` on the gathered sequence (tested)."""
+    if getattr(block.attn, "use_rope", False):
+        raise ValueError(
+            "tp_encoder_block_sp does not apply rotary embeddings — "
+            "un-rotated q/k would be silently wrong; use learned positions"
+        )
     h, _ = block.ln1.apply(params["ln1"], {}, x_shard)
     x = x_shard + tp_attention_overlapped(
         h, params["attn"], block.attn.heads, axis_name,

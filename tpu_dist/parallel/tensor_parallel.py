@@ -305,7 +305,9 @@ def tp_vocab_cross_entropy(
         raise ValueError(f"vocab {V} not divisible by axis size {n}")
     Vl = V // n
     table_loc = lax.dynamic_slice_in_dim(table, r * Vl, Vl, 0)
-    logits = h @ table_loc.T  # (b, s, Vl) — only the local slice
+    # (b, s, Vl) — only the local slice; f32 like lm_loss (the matmul may
+    # be bf16 under a compute dtype, but the softmax reduction must not)
+    logits = (h @ table_loc.T).astype(jnp.float32)
     # The max shift is numerics only — logsumexp is shift-invariant, so
     # its gradient contribution cancels analytically; stop_gradient both
     # reflects that and sidesteps pmax's missing differentiation rule.
@@ -355,6 +357,11 @@ def tp_encoder_block(block, params, x, axis_name: str = MODEL_AXIS):
     LayerNorm modules and the heads/causal config); ``params`` its
     replicated pytree.  Numerics match ``block.apply`` to fp tolerance
     (tests/test_tensor_parallel.py)."""
+    if getattr(block.attn, "use_rope", False):
+        raise ValueError(
+            "tp_encoder_block does not apply rotary embeddings — "
+            "un-rotated q/k would be silently wrong; use learned positions"
+        )
     h, _ = block.ln1.apply(params["ln1"], {}, x)
     x = x + tp_attention(
         h, params["attn"], block.attn.heads, axis_name,
