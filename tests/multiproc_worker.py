@@ -123,6 +123,63 @@ def reference_runner(rank, world):
     return single_process_reference(n_dev=4)
 
 
+def tp_worker(rank, world):
+    """Cross-process tensor parallelism: a (data x model) mesh spanning
+    both processes, running the Megatron-SP LM loss — the model-axis
+    collectives (collective matmuls, boundary ppermute, loss pmean)
+    cross the PROCESS boundary, not just device lanes.  Returns the
+    loss; must equal the dense single-process value."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpu_dist import models
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs).reshape(2, 2), ("data", "model"))
+    lm = models.TransformerLM(vocab=32, dim=16, depth=1, heads=2, max_seq=16)
+    params, _ = lm.init(jax.random.key(7))
+    tokens = models.synthetic_tokens(4, 8, 32)
+
+    def put(host, spec):
+        host = np.asarray(host)
+        return jax.make_array_from_callback(
+            host.shape, NamedSharding(mesh, spec), lambda idx: host[idx]
+        )
+
+    mapped = jax.jit(
+        jax.shard_map(
+            lambda p, t: jax.lax.pmean(
+                jax.lax.pmean(
+                    lm.loss_tensor_parallel_sp(p, t, "model"), "model"
+                ),
+                "data",
+            ),
+            mesh=mesh,
+            in_specs=(P(), P("data", "model")),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    loss = mapped(
+        jax.tree.map(lambda a: put(a, P()), params),
+        put(tokens, P("data", "model")),
+    )
+    return round(float(np.asarray(loss.addressable_shards[0].data)), 5)
+
+
+def dense_loss_runner(rank, world):
+    """The dense loss for tp_worker's exact config, single process."""
+    import jax
+
+    from tpu_dist import models
+
+    lm = models.TransformerLM(vocab=32, dim=16, depth=1, heads=2, max_seq=16)
+    params, _ = lm.init(jax.random.key(7))
+    tokens = models.synthetic_tokens(4, 8, 32)
+    logits, _ = lm.apply(params, {}, tokens)
+    return round(float(models.lm_loss(logits, tokens)), 5)
+
+
 def failing_worker(rank, world):
     """Failure-injection: rank 1 dies during init (before the barrier
     completes for anyone) — the launcher must fail-stop quickly with the
@@ -158,6 +215,16 @@ def main():
         f"process layout changed training: 1-proc {ref} vs 2-proc {res[0]}"
     )
     print("MULTIPROCESS TOPOLOGY-INVARIANCE OK")
+
+    # Cross-process TENSOR parallelism: the Megatron-SP model-axis
+    # collectives cross the process boundary; loss == dense value.
+    res = launch(tp_worker, world, platform="cpu",
+                 devices_per_proc=devices_per_proc)
+    dense = launch(dense_loss_runner, 1, platform="cpu",
+                   devices_per_proc=1)[0]
+    assert res[0] == res[1], f"tp loss diverged across processes: {res}"
+    assert abs(res[0] - dense) < 1e-3, f"tp {res[0]} != dense {dense}"
+    print("MULTIPROCESS TP OK", res, "dense", dense)
 
     # mpirun-style: no RANK env anywhere; ranks come from the bind-race
     # election in the native rendezvous (this used to deadlock).
