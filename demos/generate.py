@@ -21,6 +21,7 @@ def main():
         gen=(int, 32, "tokens to generate per stream"),
         batch=(int, 64, "training batch (streams)"),
         temperature=(float, 0.0, "0 = greedy; >0 = sampled"),
+        beams=(int, 0, "0 = greedy/sampled; k = beam search width k"),
     )
     import functools
 
@@ -45,15 +46,23 @@ def main():
             print(f"  train step {i:4d}  loss {float(loss):.4f}")
 
     prompt = tokens[:8, :2]
-    gen = jax.jit(
-        functools.partial(
-            lm.generate, steps=args.gen, temperature=args.temperature
+    if args.beams:
+        gen = jax.jit(
+            functools.partial(lm.generate_beam, steps=args.gen,
+                              beams=args.beams)
         )
-    )
-    out = gen(params, prompt, key=jax.random.key(0))
+        run_gen = lambda: gen(params, prompt)
+    else:
+        gen = jax.jit(
+            functools.partial(
+                lm.generate, steps=args.gen, temperature=args.temperature
+            )
+        )
+        run_gen = lambda: gen(params, prompt, key=jax.random.key(0))
+    out = run_gen()
     jax.block_until_ready(out)  # exclude compile from the timed pass
     t0 = time.perf_counter()
-    out = jax.block_until_ready(gen(params, prompt, key=jax.random.key(0)))
+    out = jax.block_until_ready(run_gen())
     dt = time.perf_counter() - t0
 
     # known answer: continue each prompt through the permutation table
