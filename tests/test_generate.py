@@ -588,3 +588,27 @@ class TestBeamSearch:
             functools.partial(lm.generate_beam, steps=5, beams=3)
         )(lm_params, prompt)
         assert out.shape == (1, 5)
+
+
+def test_stop_token_freezes_stream(lm, lm_params):
+    """Once a stream emits stop_token, every later position repeats it
+    (static shapes; callers trim at the first occurrence)."""
+    prompt = models.synthetic_tokens(4, 5, 64, seed=16)
+    free = np.asarray(lm.generate(lm_params, prompt, 12))
+    # pick a token that actually occurs in the free-running output
+    stop = int(free[0, 3])
+    got = np.asarray(
+        lm.generate(lm_params, prompt, 12, stop_token=stop)
+    )
+    for row in got:
+        hits = np.nonzero(row == stop)[0]
+        if hits.size:
+            assert (row[hits[0] :] == stop).all(), row
+    # the prefix before the first stop matches the unconstrained decode
+    row0 = got[0]
+    first = np.nonzero(row0 == stop)[0][0]
+    np.testing.assert_array_equal(row0[: first + 1], free[0][: first + 1])
+    # default behavior unchanged
+    np.testing.assert_array_equal(
+        np.asarray(lm.generate(lm_params, prompt, 12)), free
+    )

@@ -195,6 +195,7 @@ class TransformerLM(Module):
         top_k: int | None = None,
         top_p: float | None = None,
         cache_len: int | None = None,
+        stop_token: int | None = None,
     ):
         """Sample ``steps`` tokens after ``prompt`` ``(b, s_prompt)``.
 
@@ -208,6 +209,11 @@ class TransformerLM(Module):
         mass ``top_p`` (both cut the tail; tokens surviving both are
         renormalized by the categorical draw).  Returns ``(b, steps)``
         sampled tokens.
+
+        ``stop_token``: EOS semantics under static shapes — a stream that
+        emits it keeps emitting it for the remaining steps (frozen), so
+        callers can trim on the first occurrence; shapes and compiled
+        programs are unchanged.
         """
         from jax import lax
 
@@ -224,15 +230,19 @@ class TransformerLM(Module):
         cache = self.init_cache(b, L, dtype=params["embed"]["table"].dtype)
         logits, cache = self.apply_cached(params, prompt, cache, 0)
         last = logits[:, -1]
+        done0 = jnp.zeros((b,), bool)
 
         def body(carry, k):
-            cache, last, idx = carry
+            cache, last, idx, done = carry
             tok = sample(last, k)
+            if stop_token is not None:
+                tok = jnp.where(done, jnp.asarray(stop_token, tok.dtype), tok)
+                done = done | (tok == stop_token)
             logits, cache = self.apply_cached(params, tok[:, None], cache, idx)
-            return (cache, logits[:, 0], idx + 1), tok
+            return (cache, logits[:, 0], idx + 1, done), tok
 
         keys = jax.random.split(key, steps)
-        _, toks = lax.scan(body, (cache, last, jnp.int32(s_p)), keys)
+        _, toks = lax.scan(body, (cache, last, jnp.int32(s_p), done0), keys)
         return jnp.moveaxis(toks, 0, 1)
 
     def generate_beam(
