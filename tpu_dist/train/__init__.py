@@ -3,6 +3,7 @@
 from tpu_dist.train import checkpoint, flops, metrics, schedule
 from tpu_dist.train.optim import (
     Optimizer,
+    adafactor,
     adamw,
     clip_by_global_norm,
     decay_mask_default,
@@ -23,6 +24,7 @@ __all__ = [
     "Optimizer",
     "TrainConfig",
     "Trainer",
+    "adafactor",
     "adamw",
     "clip_by_global_norm",
     "decay_mask_default",

@@ -132,6 +132,107 @@ def adamw(
     return Optimizer(init, update)
 
 
+def adafactor(
+    lr=None,
+    *,
+    decay_rate: float = 0.8,
+    eps1: float = 1e-30,
+    eps2: float = 1e-3,
+    clip_threshold: float = 1.0,
+    weight_decay: float = 0.0,
+    min_dim_size_to_factor: int = 128,
+) -> Optimizer:
+    """Adafactor (Shazeer & Stern 2018) — the TPU-era memory-efficient
+    optimizer: the second moment of an (m, n) weight is stored FACTORED
+    as a row vector (m,) and a column vector (n,) — ``m + n`` floats
+    instead of ``m·n`` — and there is no first moment at all, so
+    optimizer HBM drops from 2x params (Adam) to ~zero.  Matrices whose
+    trailing dims are both >= ``min_dim_size_to_factor`` factor; biases
+    and small leaves keep a full accumulator.
+
+    ``lr=None`` (default) uses the paper's relative step size:
+    ``alpha_t = max(eps2, RMS(param)) * min(1e-2, 1/sqrt(t))`` — no
+    tuning needed.  An explicit float/schedule ``lr`` overrides it.
+    Updates are RMS-clipped at ``clip_threshold`` (the paper's update
+    clipping), and the second-moment decay anneals as
+    ``beta2_t = 1 - t^-decay_rate``.
+
+    State: ``{"step", "v": <per-leaf {"r","c"} or {"v"}>}`` — a pytree,
+    so sharded/npz/orbax checkpointing works unchanged.
+    """
+    lr_fn = lr if callable(lr) else (None if lr is None else (lambda _s: lr))
+
+    def _factored(p) -> bool:
+        return (
+            p.ndim >= 2
+            and p.shape[-1] >= min_dim_size_to_factor
+            and p.shape[-2] >= min_dim_size_to_factor
+        )
+
+    def init(params):
+        def leaf_state(p):
+            if _factored(p):
+                return {
+                    "r": jnp.zeros(p.shape[:-1], jnp.float32),
+                    "c": jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32),
+                }
+            return {"v": jnp.zeros(p.shape, jnp.float32)}
+
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "v": jax.tree.map(leaf_state, params),
+        }
+
+    def update(params, grads, state):
+        step = state["step"] + 1
+        sf = step.astype(jnp.float32)
+        beta2 = 1.0 - sf ** (-decay_rate)
+
+        def leaf(p, g, s):
+            g32 = g.astype(jnp.float32)
+            g2 = jnp.square(g32) + eps1
+            if "v" in s:
+                v = beta2 * s["v"] + (1 - beta2) * g2
+                u = g32 * jax.lax.rsqrt(v)
+                new_s = {"v": v}
+            else:
+                r = beta2 * s["r"] + (1 - beta2) * g2.mean(axis=-1)
+                c = beta2 * s["c"] + (1 - beta2) * g2.mean(axis=-2)
+                # vhat ~= (r ⊗ c) / mean(r): rank-1 reconstruction of
+                # the second moment (paper eq. 4)
+                r_f = jax.lax.rsqrt(r / r.mean(axis=-1, keepdims=True))
+                c_f = jax.lax.rsqrt(c)
+                u = g32 * r_f[..., None] * c_f[..., None, :]
+                new_s = {"r": r, "c": c}
+            rms_u = jnp.sqrt(jnp.mean(jnp.square(u)))
+            u = u / jnp.maximum(1.0, rms_u / clip_threshold)
+            if lr_fn is None:  # relative step size (paper alg. 4-6)
+                rms_p = jnp.sqrt(jnp.mean(jnp.square(p.astype(jnp.float32))))
+                alpha = jnp.maximum(eps2, rms_p) * jnp.minimum(
+                    1e-2, 1.0 / jnp.sqrt(sf)
+                )
+            else:
+                alpha = lr_fn(state["step"])
+            new_p = p - (alpha * u + alpha * weight_decay * p).astype(p.dtype)
+            return new_p, new_s
+
+        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        leaves_g = treedef.flatten_up_to(grads)
+        leaves_s = treedef.flatten_up_to(state["v"])
+        res = [
+            leaf(p, g, s) for p, g, s in zip(leaves_p, leaves_g, leaves_s)
+        ]
+        return (
+            treedef.unflatten([r_[0] for r_ in res]),
+            {
+                "step": step,
+                "v": treedef.unflatten([r_[1] for r_ in res]),
+            },
+        )
+
+    return Optimizer(init, update)
+
+
 def decay_mask_default(path: str, leaf) -> bool:
     """The standard AdamW decay convention: decay matrices, skip biases,
     norm scales, and any 1-D parameter."""
