@@ -139,3 +139,44 @@ class TestAdafactor:
         windows = models.synthetic_tokens(128, 16, 64)
         hist = t.fit(windows, epochs=2)
         assert hist[-1].mean_loss < hist[0].mean_loss
+
+
+def test_adafactor_decay_mask_spares_biases():
+    from tpu_dist import train
+    from tpu_dist.train.optim import decay_mask_default
+
+    params = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+    g = jax.tree.map(jnp.zeros_like, params)
+    # zero grads isolate the decay term (decay scales with alpha=lr)
+    opt = train.adafactor(1.0, weight_decay=0.5,
+                          decay_mask=decay_mask_default)
+    st = opt.init(params)
+    new, _ = opt.update(params, g, st)
+    assert float(jnp.max(jnp.abs(new["b"] - 1.0))) < 1e-6  # spared
+    assert float(jnp.max(new["w"])) < 1.0  # decayed
+
+
+def test_adafactor_refused_by_sharded_builders():
+    """Whole-tensor statistics cannot run on per-rank shards; the
+    FSDP/ZeRO builders must refuse instead of silently diverging by
+    world size."""
+    from tpu_dist import comm, models, nn, parallel, train
+
+    mesh = comm.make_mesh(4, ("data",), platform="cpu")
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+
+    def loss_fn(p, batch, key):
+        scores, _ = model.apply(p, state, batch[0], train=False)
+        return nn.nll_loss(scores, batch[1]), {}
+
+    for builder in (
+        parallel.make_fsdp_train_step,
+        parallel.make_zero1_train_step,
+    ):
+        with pytest.raises(ValueError, match="elementwise"):
+            builder(loss_fn, train.adafactor(), mesh, params)
+    # and the flag propagates through wrappers
+    wrapped = train.clip_by_global_norm(train.adafactor(), 1.0)
+    with pytest.raises(ValueError, match="elementwise"):
+        parallel.make_fsdp_train_step(loss_fn, wrapped, mesh, params)

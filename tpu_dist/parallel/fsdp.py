@@ -125,6 +125,19 @@ def fsdp_gather_params(sharded: Any, template: Any) -> Any:
     )
 
 
+def _require_elementwise(optimizer, builder: str) -> None:
+    """FSDP/ZeRO run the optimizer on flat-padded PER-RANK rows, which is
+    only valid when each element's update depends on its own history
+    alone; whole-tensor statistics (adafactor's factoring/RMS clipping)
+    would silently differ per world size."""
+    if not getattr(optimizer, "elementwise", True):
+        raise ValueError(
+            f"{builder} requires an elementwise optimizer (sgd/adamw); "
+            "this optimizer carries whole-tensor statistics that per-rank "
+            "shards would compute differently at every world size"
+        )
+
+
 _GATHER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 
 
@@ -217,6 +230,7 @@ def make_fsdp_train_step(
     replicated (pmean), params/opt-state permanently sharded.
     """
     n = mesh.shape[axis_name]
+    _require_elementwise(optimizer, "make_fsdp_train_step")
     template = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
     )
@@ -302,6 +316,7 @@ def make_zero1_train_step(
     aux)`` — params replicated, batch sharded on its leading axis.
     """
     n = mesh.shape[axis_name]
+    _require_elementwise(optimizer, "make_zero1_train_step")
     template = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
     )

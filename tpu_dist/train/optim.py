@@ -22,10 +22,19 @@ class Optimizer:
 
     ``update(params, grads, state) -> (new_params, new_state)`` is pure and
     traced into the train step, so the whole optimizer runs fused on
-    device."""
+    device.
+
+    ``elementwise``: True when the update of every parameter element
+    depends only on that element's own history (sgd, adamw) — the
+    property the FSDP/ZeRO step builders rely on to run the optimizer on
+    flat-padded per-rank shards.  Optimizers with whole-tensor
+    statistics (adafactor's factored moments / RMS clipping) must set
+    False; the sharded builders refuse them loudly instead of silently
+    computing per-shard statistics that vary with world size."""
 
     init: Callable[[Any], Any]
     update: Callable[[Any, Any, Any], tuple[Any, Any]]
+    elementwise: bool = True
 
 
 def sgd(lr, momentum: float = 0.0) -> Optimizer:
@@ -140,6 +149,7 @@ def adafactor(
     eps2: float = 1e-3,
     clip_threshold: float = 1.0,
     weight_decay: float = 0.0,
+    decay_mask=None,
     min_dim_size_to_factor: int = 128,
 ) -> Optimizer:
     """Adafactor (Shazeer & Stern 2018) — the TPU-era memory-efficient
@@ -157,8 +167,16 @@ def adafactor(
     clipping), and the second-moment decay anneals as
     ``beta2_t = 1 - t^-decay_rate``.
 
+    ``decay_mask``: same contract as `adamw`'s (``fn(path, leaf) ->
+    bool``; `decay_mask_default` skips biases/norm scales); None decays
+    everything.
+
     State: ``{"step", "v": <per-leaf {"r","c"} or {"v"}>}`` — a pytree,
-    so sharded/npz/orbax checkpointing works unchanged.
+    so npz/orbax checkpointing works unchanged.  NOT usable with the
+    FSDP/ZeRO step builders (``elementwise=False``): the factoring
+    decision, RMS clipping, and relative step size are whole-tensor
+    statistics, which per-rank shards would compute differently at
+    every world size — the builders raise instead.
     """
     lr_fn = lr if callable(lr) else (None if lr is None else (lambda _s: lr))
 
@@ -188,7 +206,7 @@ def adafactor(
         sf = step.astype(jnp.float32)
         beta2 = 1.0 - sf ** (-decay_rate)
 
-        def leaf(p, g, s):
+        def leaf(p, g, s, decay_on=True):
             g32 = g.astype(jnp.float32)
             g2 = jnp.square(g32) + eps1
             if "v" in s:
@@ -213,14 +231,21 @@ def adafactor(
                 )
             else:
                 alpha = lr_fn(state["step"])
-            new_p = p - (alpha * u + alpha * weight_decay * p).astype(p.dtype)
+            wd = weight_decay if decay_on else 0.0
+            new_p = p - (alpha * u + alpha * wd * p).astype(p.dtype)
             return new_p, new_s
 
-        leaves_p, treedef = jax.tree_util.tree_flatten(params)
+        with_paths, treedef = jax.tree_util.tree_flatten_with_path(params)
         leaves_g = treedef.flatten_up_to(grads)
         leaves_s = treedef.flatten_up_to(state["v"])
         res = [
-            leaf(p, g, s) for p, g, s in zip(leaves_p, leaves_g, leaves_s)
+            leaf(
+                p, g, s,
+                decay_mask(jax.tree_util.keystr(pth), p)
+                if decay_mask is not None
+                else True,
+            )
+            for (pth, p), g, s in zip(with_paths, leaves_g, leaves_s)
         ]
         return (
             treedef.unflatten([r_[0] for r_ in res]),
@@ -230,7 +255,7 @@ def adafactor(
             },
         )
 
-    return Optimizer(init, update)
+    return Optimizer(init, update, elementwise=False)
 
 
 def decay_mask_default(path: str, leaf) -> bool:
@@ -273,7 +298,7 @@ def clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
         grads = jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
         return optimizer.update(params, grads, state)
 
-    return Optimizer(optimizer.init, update)
+    return Optimizer(optimizer.init, update, optimizer.elementwise)
 
 
 def from_optax(tx) -> Optimizer:
@@ -323,7 +348,7 @@ def with_ema(optimizer: Optimizer, decay: float = 0.999) -> Optimizer:
         )
         return new_params, {"base": base, "ema": ema}
 
-    return Optimizer(init, update)
+    return Optimizer(init, update, optimizer.elementwise)
 
 
 def ema_params(opt_state):
