@@ -612,3 +612,23 @@ def test_stop_token_freezes_stream(lm, lm_params):
     np.testing.assert_array_equal(
         np.asarray(lm.generate(lm_params, prompt, 12)), free
     )
+
+
+def test_beam_composes_with_gqa_and_rope():
+    """generate_beam rides apply_cached, so GQA caches and rope
+    positions compose without special cases; beams=1 == greedy there
+    too."""
+    lm_x = models.TransformerLM(
+        vocab=32, dim=16, depth=1, heads=4, kv_heads=2, max_seq=32,
+        pos_embedding="rope",
+    )
+    params, _ = lm_x.init(jax.random.key(3))
+    prompt = models.synthetic_tokens(2, 4, 32, seed=17)
+    greedy = np.asarray(lm_x.generate(params, prompt, 6))
+    beam1 = np.asarray(lm_x.generate_beam(params, prompt, 6, beams=1))
+    np.testing.assert_array_equal(beam1, greedy)
+    toks, scores = lm_x.generate_beam(
+        params, prompt, 6, beams=3, return_all=True
+    )
+    assert toks.shape == (2, 3, 6)
+    assert np.isfinite(np.asarray(scores)).all()
