@@ -34,6 +34,10 @@ def main():
     from tpu_dist import comm, data, models, parallel, train
 
     world = args.world or len(comm.devices(args.platform))
+    if args.tp not in ("", "psum", "sp"):
+        raise SystemExit(
+            f"--tp must be 'psum' or 'sp' (or empty), got {args.tp!r}"
+        )
     if args.tp:
         if world % 2:
             raise SystemExit(f"--tp needs an even world, got {world}")

@@ -137,14 +137,56 @@ def test_fsdp_restore_rejects_foreign_checkpoint(tmp_path, mesh):
         t.restore(tmp_path / "alien")
 
 
-def test_fsdp_rejects_stateful_and_accum(mesh):
+def test_fsdp_rejects_stateful(mesh):
     with pytest.raises(ValueError, match="stateless"):
         train.Trainer(
             models.resnet18(num_classes=10), (3, 32, 32), mesh,
             train.TrainConfig(fsdp=True),
         )
-    with pytest.raises(ValueError, match="accum_steps"):
-        train.Trainer(
-            models.mnist_net(), models.IN_SHAPE, mesh,
-            train.TrainConfig(fsdp=True, accum_steps=2),
+
+
+@pytest.mark.parametrize("builder", ["fsdp", "zero1"])
+def test_sharded_accum_matches_unaccumulated(mesh, builder):
+    """VERDICT r4 #6: accum_steps now composes with fsdp/zero1 — the
+    microbatch-scanned sharded step must reproduce the single-shot
+    update (mean-gradient identity) to fp tolerance.  Dropout-free loss
+    so the comparison is deterministic."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import nn, parallel
+
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+    opt = train.sgd(0.05, momentum=0.9)
+
+    def loss_fn(p, batch, key):
+        x, y = batch
+        scores, _ = model.apply(p, state, x, train=False)
+        return nn.nll_loss(scores, y), {}
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(16,) + models.IN_SHAPE), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32)
+    batch = parallel.shard_batch((x, y), mesh)
+    make = (
+        parallel.make_fsdp_train_step
+        if builder == "fsdp"
+        else parallel.make_zero1_train_step
+    )
+    outs = {}
+    for k in (1, 2):
+        step, p_sh, o_sh = make(
+            loss_fn, opt, mesh, params, donate=False, accum_steps=k
         )
+        losses = []
+        for i in range(3):
+            p_sh, o_sh, loss, _ = step(p_sh, o_sh, batch, jax.random.key(9))
+            losses.append(float(loss))
+        outs[k] = (jax.tree.map(np.asarray, p_sh), losses)
+    np.testing.assert_allclose(outs[1][1], outs[2][1], rtol=2e-4, atol=1e-5)
+    for a, b in zip(
+        jax.tree.leaves(outs[1][0]), jax.tree.leaves(outs[2][0]),
+        strict=True,
+    ):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)

@@ -133,6 +133,56 @@ def _pmean_float_leaves(tree: Any, axis_name: str) -> Any:
     )
 
 
+def accumulate_microbatches(
+    grads_and_metrics, params, model_state, batch, key, accum_steps: int
+):
+    """The microbatch-accumulation scan shared by every step builder
+    (replicated DP here; FSDP/ZeRO-1 in `parallel.fsdp`): split the
+    local batch into ``accum_steps`` microbatches along axis 0 and scan
+    them with a gradient-sum carry, so only one microbatch's activations
+    are ever live.
+
+    ``grads_and_metrics(params, state, micro_batch, key) -> (grads,
+    loss, new_state, aux)``.  Returns ``(mean_grads, mean_loss,
+    final_state, aux)`` — aux float leaves averaged over microbatches,
+    non-float leaves from the last microbatch (the step contract).
+    The per-microbatch key is ``fold_in(key, i)``.
+    """
+
+    def to_micro(a):
+        if a.shape[0] % accum_steps:
+            raise ValueError(
+                f"local batch {a.shape[0]} not divisible by "
+                f"accum_steps {accum_steps}"
+            )
+        return a.reshape(
+            (accum_steps, a.shape[0] // accum_steps) + a.shape[1:]
+        )
+
+    micro = jax.tree.map(to_micro, batch)
+    g0 = jax.tree.map(jnp.zeros_like, params)
+
+    def body(carry, xs):
+        state, gacc, lacc = carry
+        mb, i = xs
+        g, loss, state, aux = grads_and_metrics(
+            params, state, mb, jax.random.fold_in(key, i)
+        )
+        return (state, jax.tree.map(jnp.add, gacc, g), lacc + loss), aux
+
+    (new_state, gsum, lsum), auxs = lax.scan(
+        body, (model_state, g0, 0.0), (micro, jnp.arange(accum_steps))
+    )
+    grads = jax.tree.map(lambda g: g / accum_steps, gsum)
+    aux = jax.tree.map(
+        lambda a: a.mean(0)
+        if jnp.issubdtype(a.dtype, jnp.floating)
+        else a[-1],
+        auxs,
+    )
+    return grads, lsum / accum_steps, new_state, aux
+
+
 def make_stateful_train_step(
     loss_fn: Callable[..., Any],
     optimizer,
@@ -143,6 +193,7 @@ def make_stateful_train_step(
     grad_reduce: str = "psum",
     accum_steps: int = 1,
     extra_grad_axes: tuple[str, ...] = (),
+    grad_psum_axes: tuple[str, ...] = (),
     batch_spec=None,
 ):
     """Like `make_train_step` but threads non-differentiated model state
@@ -152,7 +203,11 @@ def make_stateful_train_step(
     loss/state/aux) over — the tensor-parallel gradient contract: a
     model-sharded loss's per-rank grad is its shard's contribution, and
     the model-axis mean recovers the dense gradient (tested for both TP
-    layouts).  ``batch_spec``: PartitionSpec for the batch (default
+    layouts).  ``grad_psum_axes``: axes whose per-rank grads PARTITION
+    the dense gradient and must therefore SUM — the pipeline-parallel
+    contract (`TransformerLM.loss_pipeline`: each rank's grads are
+    nonzero only on its stage's blocks; loss and aux still pmean, being
+    replicated).  ``batch_spec``: PartitionSpec for the batch (default
     ``P(axis_name)``) — e.g. ``P('data', 'model')`` shards token windows
     over batch AND sequence for the Megatron-SP layout.
 
@@ -185,40 +240,9 @@ def make_stateful_train_step(
         return grads, loss, new_state, aux
 
     def accumulate(params, model_state, batch, key):
-        """Scan over microbatches, summing grads/loss in the carry."""
-        def to_micro(a):
-            if a.shape[0] % accum_steps:
-                raise ValueError(
-                    f"local batch {a.shape[0]} not divisible by "
-                    f"accum_steps {accum_steps}"
-                )
-            return a.reshape(
-                (accum_steps, a.shape[0] // accum_steps) + a.shape[1:]
-            )
-
-        micro = jax.tree.map(to_micro, batch)
-        g0 = jax.tree.map(jnp.zeros_like, params)
-
-        def body(carry, xs):
-            state, gacc, lacc = carry
-            mb, i = xs
-            g, loss, state, aux = grads_and_metrics(
-                params, state, mb, jax.random.fold_in(key, i)
-            )
-            gacc = jax.tree.map(jnp.add, gacc, g)
-            return (state, gacc, lacc + loss), aux
-
-        (new_state, gsum, lsum), auxs = lax.scan(
-            body, (model_state, g0, 0.0), (micro, jnp.arange(accum_steps))
+        return accumulate_microbatches(
+            grads_and_metrics, params, model_state, batch, key, accum_steps
         )
-        grads = jax.tree.map(lambda g: g / accum_steps, gsum)
-        aux = jax.tree.map(
-            lambda a: a.mean(0)
-            if jnp.issubdtype(a.dtype, jnp.floating)
-            else a[-1],
-            auxs,
-        )
-        return grads, lsum / accum_steps, new_state, aux
 
     def spmd_step(params, model_state, opt_state, batch, key):
         # fold over the DATA axis only: model-axis ranks run the same
@@ -231,6 +255,11 @@ def make_stateful_train_step(
         for ax in extra_grad_axes:
             grads = jax.tree.map(lambda g: lax.pmean(g, ax), grads)
             loss = lax.pmean(loss, ax)
+            new_state = _pmean_float_leaves(new_state, ax)
+            aux = _pmean_float_leaves(aux, ax)
+        for ax in grad_psum_axes:
+            grads = jax.tree.map(lambda g: lax.psum(g, ax), grads)
+            loss = lax.pmean(loss, ax)  # replicated loss: mean, not sum
             new_state = _pmean_float_leaves(new_state, ax)
             aux = _pmean_float_leaves(aux, ax)
         new_state = _pmean_float_leaves(new_state, axis_name)

@@ -70,6 +70,25 @@ def _reduce_scatter_grads(grads: Any, n: int, axis_name: str) -> Any:
     )
 
 
+def _accumulate_grads(loss_grad_fn, params, batch, key, accum_steps: int):
+    """Microbatch gradient accumulation for the sharded step builders —
+    the stateless adapter over the shared scan
+    (`data_parallel.accumulate_microbatches`, one contract for DP and
+    ZeRO).  ``loss_grad_fn(full, micro_batch, key) -> ((loss, aux),
+    grads)`` on FULL logical params; returns ``(mean_grads, mean_loss,
+    aux)``."""
+    from tpu_dist.parallel.data_parallel import accumulate_microbatches
+
+    def gm(p, _state, mb, k):
+        (loss, aux), g = loss_grad_fn(p, mb, k)
+        return g, loss, _state, aux
+
+    grads, loss, _, aux = accumulate_microbatches(
+        gm, params, None, batch, key, accum_steps
+    )
+    return grads, loss, aux
+
+
 def _spec_of(axis_name: str):
     """Per-leaf partition spec: (n, k) leaves sharded over the axis,
     scalar leaves (e.g. a schedule step counter) replicated."""
@@ -125,17 +144,30 @@ def fsdp_gather_params(sharded: Any, template: Any) -> Any:
     )
 
 
-def _require_elementwise(optimizer, builder: str) -> None:
-    """FSDP/ZeRO run the optimizer on flat-padded PER-RANK rows, which is
-    only valid when each element's update depends on its own history
-    alone; whole-tensor statistics (adafactor's factoring/RMS clipping)
-    would silently differ per world size."""
+def _sharded_update_fn(optimizer, builder: str):
+    """The optimizer update to run on flat-padded PER-RANK rows, as
+    ``fn(params, grads, state, axis_name)``.
+
+    An optimizer advertising ``shard_update`` (e.g. `clip_by_global_norm`,
+    which psums squared shard norms to the true global norm) is used
+    as-is; otherwise the plain update is valid only when each element's
+    update depends on its own history alone — whole-tensor statistics
+    (adafactor's factoring/RMS clipping) would silently differ per world
+    size, so non-elementwise optimizers without a sharded form are
+    refused loudly."""
+    sharded = getattr(optimizer, "shard_update", None)
+    if sharded is not None:
+        return sharded
     if not getattr(optimizer, "elementwise", True):
         raise ValueError(
-            f"{builder} requires an elementwise optimizer (sgd/adamw); "
+            f"{builder} requires an elementwise optimizer (sgd/adamw) or "
+            "one with a shard_update (clip_by_global_norm provides one); "
             "this optimizer carries whole-tensor statistics that per-rank "
             "shards would compute differently at every world size"
         )
+    return lambda params, grads, state, _axis: optimizer.update(
+        params, grads, state
+    )
 
 
 _GATHER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
@@ -201,6 +233,7 @@ def make_fsdp_train_step(
     donate: bool = True,
     grad_pmean_axes: tuple[str, ...] = (),
     batch_spec=None,
+    accum_steps: int = 1,
 ):
     """Build the compiled FSDP train step.
 
@@ -223,6 +256,12 @@ def make_fsdp_train_step(
       batch_spec: PartitionSpec for the batch (default ``P(axis_name)``)
         — e.g. ``P('data', 'model')`` for the Megatron-SP layout, whose
         token windows shard over batch AND sequence.
+      accum_steps: microbatch gradient accumulation (``lax.scan`` with a
+        gradient-sum carry, like the replicated DP step): activations
+        live one microbatch at a time; the reduce-scatter still fires
+        once per step on the mean gradient.  Params stay gathered for
+        the whole step (the per-microbatch re-gather trade is left to
+        XLA's scheduler).
 
     Returns ``(step, sharded_params, opt_state)`` with
     ``step(sharded_params, opt_state, batch, key) -> (sharded_params,
@@ -230,25 +269,33 @@ def make_fsdp_train_step(
     replicated (pmean), params/opt-state permanently sharded.
     """
     n = mesh.shape[axis_name]
-    _require_elementwise(optimizer, "make_fsdp_train_step")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    opt_update = _sharded_update_fn(optimizer, "make_fsdp_train_step")
     template = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
     )
     sharded_params = fsdp_shard_params(params, mesh, axis_name)
     opt_state = _commit_scalars(optimizer.init(sharded_params), mesh)
+    vg = jax.value_and_grad(loss_fn, has_aux=True)
 
     def spmd_step(local_shards, opt_state, batch, key):
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
         full = _unshard_rows(local_shards, template, axis_name)
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            full, batch, key
-        )
+        if accum_steps == 1:
+            (loss, aux), grads = vg(full, batch, key)
+        else:
+            grads, loss, aux = _accumulate_grads(
+                vg, full, batch, key, accum_steps
+            )
         if grad_pmean_axes:  # e.g. the TP model axis (gradient contract)
             grads = jax.tree.map(
                 lambda g: lax.pmean(g, grad_pmean_axes), grads
             )
         gshards = _reduce_scatter_grads(grads, n, axis_name)
-        new_shards, new_opt = optimizer.update(local_shards, gshards, opt_state)
+        new_shards, new_opt = opt_update(
+            local_shards, gshards, opt_state, axis_name
+        )
         # aux mirrors make_stateful_train_step's contract: float leaves
         # are cross-rank means, not one rank's local value.  Loss/aux
         # reduce over the extra axes too so the P() out_spec is honest.
@@ -295,6 +342,7 @@ def make_zero1_train_step(
     *,
     axis_name: str = DATA_AXIS,
     donate: bool = True,
+    accum_steps: int = 1,
 ):
     """ZeRO-1: replicated parameters, SHARDED optimizer state — the
     middle point between replicated DP and FSDP/ZeRO-3.
@@ -311,12 +359,18 @@ def make_zero1_train_step(
     sharding is implicit here: the reduce-scatter means full gradients
     never persist — XLA frees them within the step.)
 
+    ``accum_steps``: microbatch gradient accumulation, identical
+    contract to `make_fsdp_train_step`.
+
     Returns ``(step, replicated_params, sharded_opt_state)`` with
     ``step(params, opt_state, batch, key) -> (params, opt_state, loss,
     aux)`` — params replicated, batch sharded on its leading axis.
     """
     n = mesh.shape[axis_name]
-    _require_elementwise(optimizer, "make_zero1_train_step")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    opt_update = _sharded_update_fn(optimizer, "make_zero1_train_step")
+    vg = jax.value_and_grad(loss_fn, has_aux=True)
     template = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
     )
@@ -341,12 +395,15 @@ def make_zero1_train_step(
 
     def spmd_step(full_params, opt_state, batch, key):
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            full_params, batch, key
-        )
+        if accum_steps == 1:
+            (loss, aux), grads = vg(full_params, batch, key)
+        else:
+            grads, loss, aux = _accumulate_grads(
+                vg, full_params, batch, key, accum_steps
+            )
         gshards = _reduce_scatter_grads(grads, n, axis_name)
-        new_rows, new_opt = optimizer.update(
-            local_rows(full_params), gshards, opt_state
+        new_rows, new_opt = opt_update(
+            local_rows(full_params), gshards, opt_state, axis_name
         )
         aux = _pmean_float_leaves(aux, axis_name)
         return (

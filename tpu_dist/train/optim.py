@@ -30,11 +30,21 @@ class Optimizer:
     flat-padded per-rank shards.  Optimizers with whole-tensor
     statistics (adafactor's factored moments / RMS clipping) must set
     False; the sharded builders refuse them loudly instead of silently
-    computing per-shard statistics that vary with world size."""
+    computing per-shard statistics that vary with world size.
+
+    ``shard_update``: optional ``(params, grads, state, axis_name) ->
+    (new_params, new_state)`` — the sharded-execution form, called by
+    the FSDP/ZeRO-1 builders INSIDE shard_map on per-rank gradient
+    shards when present.  It may use collectives over ``axis_name`` to
+    reconstruct whole-tree statistics (e.g. `clip_by_global_norm` psums
+    squared shard norms so every rank clips by the TRUE global norm).
+    An optimizer with ``elementwise=False`` but a ``shard_update`` is
+    still accepted by the sharded builders."""
 
     init: Callable[[Any], Any]
     update: Callable[[Any, Any, Any], tuple[Any, Any]]
     elementwise: bool = True
+    shard_update: Callable[[Any, Any, Any, str], tuple[Any, Any]] | None = None
 
 
 def sgd(lr, momentum: float = 0.0) -> Optimizer:
@@ -278,6 +288,19 @@ def global_norm(tree: Any) -> jax.Array:
     return _gn(tree)
 
 
+def _inner_sharded(optimizer: Optimizer):
+    """The sharded-execution form of ``optimizer`` for wrapper
+    composition: its own ``shard_update`` when present, a pass-through
+    adapter when it is elementwise (per-rank rows are valid as-is), else
+    None — the wrapper then has no sharded form either and the FSDP/
+    ZeRO-1 builders refuse it."""
+    if optimizer.shard_update is not None:
+        return optimizer.shard_update
+    if optimizer.elementwise:
+        return lambda p, g, s, _ax: optimizer.update(p, g, s)
+    return None
+
+
 def clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
     """Wrap an optimizer with global-norm gradient clipping: when the
     gradient pytree's L2 norm exceeds ``max_norm``, every leaf is scaled
@@ -288,24 +311,60 @@ def clip_by_global_norm(optimizer: Optimizer, max_norm: float) -> Optimizer:
     Runs inside the compiled train step; under data parallelism it
     composes after the gradient ``pmean``, so every replica clips the
     same averaged gradient and replicas stay bit-identical.
+
+    Global-norm clipping is a WHOLE-TREE statistic, so the result is
+    ``elementwise=False``: on per-rank gradient shards a local norm
+    would differ per rank and per world size (silent divergence).  The
+    FSDP/ZeRO-1 builders instead use the provided ``shard_update``,
+    which psums the squared shard norms over the data axis — every rank
+    clips by the true global norm and the trajectory matches dense.
     """
     if max_norm <= 0:
         raise ValueError(f"max_norm must be > 0, got {max_norm}")
 
-    def update(params, grads, state):
-        norm = global_norm(grads)
+    def _clip(grads, norm):
         scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
-        grads = jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
-        return optimizer.update(params, grads, state)
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
 
-    return Optimizer(optimizer.init, update, optimizer.elementwise)
+    def update(params, grads, state):
+        return optimizer.update(params, _clip(grads, global_norm(grads)), state)
+
+    # Sharded form: shard rows partition the full gradient over the data
+    # axis (zero padding contributes nothing), so psum of squared local
+    # norms == squared global norm.  Delegate to the inner optimizer's
+    # own sharded/elementwise update on the clipped shards.
+    inner_sharded = _inner_sharded(optimizer)
+    if inner_sharded is not None:
+        def shard_update(params, grads, state, axis_name):
+            from jax import lax
+
+            # sum of squares directly (no sqrt-then-square round trip)
+            sq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+            norm = jnp.sqrt(lax.psum(sq, axis_name))
+            return inner_sharded(params, _clip(grads, norm), state, axis_name)
+    else:
+        shard_update = None
+
+    return Optimizer(optimizer.init, update, elementwise=False,
+                     shard_update=shard_update)
 
 
-def from_optax(tx) -> Optimizer:
+def from_optax(tx, *, elementwise: bool = False) -> Optimizer:
     """Adapt an optax ``GradientTransformation`` to this framework's
     `Optimizer` (init/update) contract, so the whole optax catalog drops
-    into `make_train_step` / `Trainer` / FSDP unchanged.  State is the
-    optax state pytree — checkpointable like any other."""
+    into `make_train_step` / `Trainer` unchanged.  State is the optax
+    state pytree — checkpointable like any other.
+
+    ``elementwise`` defaults to **False**: an arbitrary optax chain may
+    carry whole-tensor statistics (``optax.adafactor``,
+    ``optax.clip_by_global_norm``) that per-rank shards would compute
+    differently at every world size, so the FSDP/ZeRO-1 builders refuse
+    the result by default.  Pass ``elementwise=True`` only when every
+    transform in the chain is per-element (e.g. plain ``optax.adamw``)
+    and you want it on the sharded step builders."""
 
     def init(params):
         return tx.init(params)
@@ -316,7 +375,7 @@ def from_optax(tx) -> Optimizer:
 
         return optax.apply_updates(params, updates), new_state
 
-    return Optimizer(init, update)
+    return Optimizer(init, update, elementwise=elementwise)
 
 
 def with_ema(optimizer: Optimizer, decay: float = 0.999) -> Optimizer:
@@ -340,15 +399,27 @@ def with_ema(optimizer: Optimizer, decay: float = 0.999) -> Optimizer:
             "ema": jax.tree.map(lambda a: jnp.array(a, copy=True), params),
         }
 
+    def _track(new_params, ema):
+        return jax.tree.map(
+            lambda e, p: decay * e + (1.0 - decay) * p, ema, new_params
+        )
+
     def update(params, grads, state):
         new_params, base = optimizer.update(params, grads, state["base"])
-        ema = jax.tree.map(
-            lambda e, p: decay * e + (1.0 - decay) * p,
-            state["ema"], new_params,
-        )
-        return new_params, {"base": base, "ema": ema}
+        return new_params, {"base": base, "ema": _track(new_params, state["ema"])}
 
-    return Optimizer(init, update, optimizer.elementwise)
+    # EMA itself is per-element, so the sharded form exists iff the
+    # inner optimizer is shardable (elementwise or shard_update-capable).
+    inner_sharded = _inner_sharded(optimizer)
+    if inner_sharded is not None:
+        def shard_update(params, grads, state, axis_name):
+            new_params, base = inner_sharded(params, grads, state["base"], axis_name)
+            return new_params, {"base": base, "ema": _track(new_params, state["ema"])}
+    else:
+        shard_update = None
+
+    return Optimizer(init, update, optimizer.elementwise,
+                     shard_update=shard_update)
 
 
 def ema_params(opt_state):

@@ -110,8 +110,6 @@ class Trainer:
                 "BatchNorm running stats); use "
                 "parallel.make_fsdp_train_step directly for custom state"
             )
-        if sharded_mode and self.config.accum_steps != 1:
-            raise ValueError("accum_steps > 1 is not supported with fsdp/zero1")
         if not sharded_mode:
             self.params = parallel.replicate(params, mesh)
             self.model_state = parallel.replicate(state, mesh)
@@ -171,7 +169,8 @@ class Trainer:
                 else parallel.make_zero1_train_step
             )
             fstep, p_sh, o_sh = make(
-                fsdp_loss, self.optimizer, mesh, params
+                fsdp_loss, self.optimizer, mesh, params,
+                accum_steps=self.config.accum_steps,
             )
             # Same donation guard as the replicated path: the fsdp step
             # donates both trees, so a buffer shared between them (e.g. an
