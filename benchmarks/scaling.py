@@ -172,7 +172,21 @@ def main():
         f"scaling efficiency {worlds[0]}->{worlds[-1]}: {eff_last:.1%}",
         file=sys.stderr,
     )
+    platform = jax.devices()[0].platform
+    # VERDICT r4 #9: on a shared-host simulation every "chip" competes
+    # for the same cores, so the efficiency column measures host
+    # contention, not interconnect — mark the artifact itself untrusted
+    # so no round mistakes simulated efficiency for the >=90% target.
+    trusted = platform == "tpu"
+    if not trusted:
+        print(
+            f"NOTE: platform={platform} shares one host across all "
+            "simulated chips — efficiency numbers are NOT scaling "
+            "evidence (trusted=false in the JSON)",
+            file=sys.stderr,
+        )
     print(json.dumps({"metric": "dp_weak_scaling", "model": args.model,
+                      "platform": platform, "trusted": trusted,
                       "worlds": table}))
 
 

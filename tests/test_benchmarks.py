@@ -56,3 +56,71 @@ def test_decode_bench_dense_smoke():
     assert out["metric"] == "lm_decode_tokens_per_sec"
     assert out["mode"] == "dense"
     assert out["rows"][0]["tokens_per_sec"] > 0
+
+
+def test_bench_forced_lm_path(tmp_path):
+    """VERDICT r4 #1: when bench.py sees a live TPU it must run the
+    compute-bound flagship inline and emit lm_mfu/lm_best at TOP LEVEL.
+    Forced here on CPU (TPU_DIST_BENCH_FORCE_LM=1, tiny model) to prove
+    the path executes end-to-end before a hardware window exists."""
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=ROOT,
+        env={
+            **os.environ,
+            "TPU_DIST_PLATFORM": "cpu",  # skip the tunnel probe
+            "TPU_DIST_BENCH_FORCE_LM": "1",
+            "TPU_DIST_BENCH_LM_ARGS": (
+                "--dim 64 --depth 1 --heads 2 --vocab 128 "
+                "--configs 2x64 --steps 2 --warmup 1"
+            ),
+        },
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "lm_mfu" in out, out  # top-level judged field exists
+    assert out["lm_platform"] == "cpu"
+    # mfu is None on CPU (no public peak) but the sweep really ran:
+    assert out["lm_best"]["tokens_per_sec"] > 0
+
+
+def test_scaling_marks_cpu_sim_untrusted():
+    """VERDICT r4 #9: the scaling JSON must carry platform + trusted
+    flags so shared-host efficiency can never be mistaken for the >=90%
+    hardware target."""
+    out = run_bench(
+        "scaling.py", "--platform", "cpu", "--batch-per-chip", "4",
+        "--steps", "2", "--max-world", "2",
+    )
+    assert out["metric"] == "dp_weak_scaling"
+    assert out["platform"] == "cpu"
+    assert out["trusted"] is False
+
+
+def test_bench_forced_lm_path_survives_bad_args():
+    """A malformed TPU_DIST_BENCH_LM_ARGS (argparse SystemExit) must not
+    kill the bench — the MNIST headline JSON still comes out, without
+    the lm_* fields."""
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=ROOT,
+        env={
+            **os.environ,
+            "TPU_DIST_PLATFORM": "cpu",  # skip the tunnel probe
+            "TPU_DIST_BENCH_FORCE_LM": "1",
+            # genuinely unknown flag: argparse prefix-matching would
+            # silently accept a mere truncation like "--step"
+            "TPU_DIST_BENCH_LM_ARGS": "--bogus 2",
+        },
+    )
+    assert proc.returncode == 0, (proc.stdout, proc.stderr[-2000:])
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "mnist_dp_train_samples_per_sec_per_chip"
+    assert "lm_mfu" not in out
+    assert "inline LM MFU run failed" in proc.stderr
