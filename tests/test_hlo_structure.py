@@ -1,0 +1,182 @@
+"""Compiled-HLO structure assertions (VERDICT r4 #2): the performance
+claims that do not need hardware to verify.
+
+docs/perf.md claims the fused DP step issues ONE fused gradient
+all-reduce (the didactic gap vs the reference's per-parameter blocking
+calls, /root/reference/train_dist.py:97-99 + tuto.md:319-320), that the
+FSDP step reduce-scatters instead of all-reducing, that the collective
+matmuls decompose their gathers into ppermute rings, and that nothing in
+a train step stages through the host.  With the TPU tunnel dead, the
+strongest available evidence is the compiled artifact itself — these
+tests grep the post-optimization HLO of the actual step builders on the
+CPU-sim mesh (XLA's collective lowering/combining passes run for CPU
+collectives too).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist import comm, models, nn, parallel, train
+
+N = 8
+
+
+def _compiled_text(jitted, *args):
+    return jitted.lower(*args).compile().as_text()
+
+
+def _ops(txt, name):
+    """HLO instructions whose op name is exactly ``name`` (catches both
+    sync ops and the -start half of async pairs; excludes the -done
+    half so async ops are not double-counted)."""
+    return re.findall(rf"{name}(?:-start)?\(", txt)
+
+
+HOST_OPS = ("infeed", "outfeed", "copy-to-host", "copy-from-host")
+
+
+def _dp_step_and_args():
+    mesh = comm.make_mesh(N, ("data",), platform="cpu")
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+
+    def loss_fn(p, batch, key):
+        x, y = batch
+        scores, _ = model.apply(p, state, x, train=False)
+        return nn.nll_loss(scores, y), {}
+
+    opt = train.sgd(0.05, momentum=0.5)
+    step = parallel.make_train_step(loss_fn, opt, mesh, donate=False)
+    x = jnp.zeros((2 * N,) + models.IN_SHAPE, jnp.float32)
+    y = jnp.zeros((2 * N,), jnp.int32)
+    sb = parallel.shard_batch((x, y), mesh)
+    p = parallel.replicate(params, mesh)
+    o = parallel.replicate(opt.init(params), mesh)
+    return jax.jit(step), (p, o, sb, jax.random.key(0)), params
+
+
+class TestDPStepHLO:
+    def test_gradient_allreduce_is_fused_not_per_param(self):
+        """The compiled step must carry the gradient payload in ONE
+        all-reduce (a single variadic op over the grad leaves — XLA's
+        combiner may keep the scalar loss/aux reduction separate, hence
+        <= 2 total), NOT the reference's one-blocking-call-per-parameter
+        structure (8 param leaves -> >= 8 ops)."""
+        jitted, args, params = _dp_step_and_args()
+        txt = _compiled_text(jitted, *args)
+        n_ar = len(_ops(txt, "all-reduce"))
+        n_leaves = len(jax.tree.leaves(params))
+        assert n_ar >= 1, "no all-reduce in the DP step at all"
+        assert n_ar <= 2, (
+            f"{n_ar} all-reduces in the compiled DP step — the gradient "
+            f"payload is not fused (per-param structure would be "
+            f">= {n_leaves})"
+        )
+
+    def test_no_reduce_scatter_in_replicated_dp(self):
+        jitted, args, _ = _dp_step_and_args()
+        txt = _compiled_text(jitted, *args)
+        assert not _ops(txt, "reduce-scatter")
+
+    def test_no_host_transfers_in_train_step(self):
+        """Collectives ride the device mesh; nothing stages through the
+        host inside the compiled step."""
+        jitted, args, _ = _dp_step_and_args()
+        txt = _compiled_text(jitted, *args)
+        for op in HOST_OPS:
+            assert not _ops(txt, op), f"{op} found in the train step"
+
+
+class TestFSDPStepHLO:
+    def test_fsdp_reduce_scatters_instead_of_allreducing(self):
+        """ZeRO-3's wire structure: the gradient payload leaves via
+        ReduceScatter (each rank reduces exactly its shard) and the
+        parameters return via AllGather; the only all-reduce left is the
+        scalar loss/aux reduction."""
+        mesh = comm.make_mesh(N, ("data",), platform="cpu")
+        model = models.mnist_net()
+        params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+
+        def loss_fn(p, batch, key):
+            x, y = batch
+            scores, _ = model.apply(p, state, x, train=False)
+            return nn.nll_loss(scores, y), {}
+
+        opt = train.sgd(0.05, momentum=0.5)
+        step, p_sh, o_sh = parallel.make_fsdp_train_step(
+            loss_fn, opt, mesh, params, donate=False
+        )
+        x = jnp.zeros((2 * N,) + models.IN_SHAPE, jnp.float32)
+        y = jnp.zeros((2 * N,), jnp.int32)
+        sb = parallel.shard_batch((x, y), mesh)
+        txt = _compiled_text(
+            jax.jit(step), p_sh, o_sh, sb, jax.random.key(0)
+        )
+        assert _ops(txt, "reduce-scatter"), "no reduce-scatter in FSDP step"
+        assert _ops(txt, "all-gather"), "no all-gather in FSDP step"
+        # any remaining all-reduce must be scalar-sized (loss/aux), not
+        # the gradient payload
+        for m in re.finditer(
+            r"(\S+) = \S+ all-reduce(?:-start)?\(", txt
+        ):
+            line = txt[m.start(): txt.find("\n", m.start())]
+            shapes = re.findall(r"f32\[([\d,]*)\]", line.split("=")[0])
+            for s in shapes:
+                elems = int(np.prod([int(x) for x in s.split(",") if x] or [1]))
+                assert elems <= 16, (
+                    f"large all-reduce ({elems} elems) in FSDP step: {line}"
+                )
+        for op in HOST_OPS:
+            assert not _ops(txt, op), f"{op} found in the FSDP step"
+
+
+class TestCollectiveMatmulHLO:
+    def test_tp_mlp_overlapped_is_permutes_plus_dots(self):
+        """The collective-matmul claim: `tp_mlp_overlapped` lowers to
+        ppermute ring hops interleaved with per-chunk dots — NO
+        standalone all-gather or reduce-scatter barrier ops remain, and
+        both rings' hops are present (2 x (n-1) collective-permutes)."""
+        mesh = comm.make_mesh(N, ("model",), platform="cpu")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        d, hidden, rows_l = 16, 32, 4
+        mlp_params = {
+            "fc1": {
+                "w": jnp.ones((d, hidden), jnp.float32),
+                "b": jnp.zeros((hidden,), jnp.float32),
+            },
+            "fc2": {
+                "w": jnp.ones((hidden, d), jnp.float32),
+                "b": jnp.zeros((d,), jnp.float32),
+            },
+        }
+        mapped = jax.jit(
+            jax.shard_map(
+                lambda x, p: parallel.tp_mlp_overlapped(x, p, "model"),
+                mesh=mesh,
+                in_specs=(P("model"), P()),
+                out_specs=P("model"),
+                check_vma=False,
+            )
+        )
+        x = jnp.ones((N * rows_l, d), jnp.float32)
+        args = (
+            jax.device_put(x, NamedSharding(mesh, P("model"))),
+            jax.device_put(mlp_params, NamedSharding(mesh, P())),
+        )
+        txt = _compiled_text(mapped, *args)
+        n_perm = len(_ops(txt, "collective-permute"))
+        assert n_perm >= 2 * (N - 1), (
+            f"expected >= {2 * (N - 1)} ring hops, found {n_perm}"
+        )
+        assert not _ops(txt, "all-gather"), (
+            "standalone all-gather barrier in the collective matmul"
+        )
+        assert not _ops(txt, "reduce-scatter"), (
+            "standalone reduce-scatter barrier in the collective matmul"
+        )
+        assert len(_ops(txt, "dot")) >= 2 * N - 1 or "fusion" in txt
