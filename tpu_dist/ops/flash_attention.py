@@ -8,10 +8,14 @@ materialized in HBM.  Grid: one program per (batch·head, query-block);
 each program scans key/value blocks with ``lax.fori_loop``.
 
 Interpret-mode tested against `tpu_dist.nn.dot_product_attention` on CPU
-(values and gradients); compiled on TPU.  Differentiable: the forward
-kernel emits per-row LSE, and a custom VJP runs the standard flash
-backward recurrence scanned over key blocks in plain XLA (peak
-intermediate (S, bk)); a fused backward *kernel* remains a ROADMAP item.
+(values and gradients); compiled on TPU.  Differentiable END TO END in
+Pallas: the forward kernel emits per-row LSE, and the custom VJP runs
+TWO backward kernels — `_dkv_kernel` (one program per key block, scanning
+query blocks for dK/dV) and `_dq_kernel` (one program per query block,
+scanning key blocks for dQ) — so the (S, S) score matrix is never
+materialized on either pass and ~2/3 of a train step's attention FLOPs
+run through hand-written kernels (benchmarks/kernels.py measures fwd and
+fwd+bwd against dense XLA).
 """
 
 from __future__ import annotations

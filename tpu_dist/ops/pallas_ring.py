@@ -10,9 +10,14 @@ inter-chip DMAs (`make_async_remote_copy` over ICI), with neighbor
 barriers and double-buffered communication slots, per the TPU kernel
 playbook (/opt/skills/guides/pallas_guide.md, "Ring Collectives").
 
-Requires ≥2 real TPU chips (RDMA has no CPU interpretation) — tests are
-gated with the ``tpu`` marker; on other platforms `ring_all_reduce_pallas`
-falls back to the ppermute ring so callers can use one entry point.
+COMPILED execution needs ≥2 real TPU chips (those tests carry the
+``tpu`` marker; on other platforms `ring_all_reduce_pallas` falls back
+to the ppermute ring so callers can use one entry point).  The kernel
+itself, though, is exercised EVERYWHERE: Pallas's TPU interpret mode
+(`pltpu.InterpretParams`) simulates the DMA semaphores and remote copies
+across the CPU-sim mesh, so the un-gated tests run the real kernel body
+— barriers, double buffering, RDMA ordering — and cross-check it against
+``lax.psum`` (tests/test_ops.py::TestPallasRing).
 """
 
 from __future__ import annotations
@@ -73,7 +78,15 @@ def _ring_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem, *, axis_name):
     lax.fori_loop(0, n - 1, step_body, None)
 
 
-def _pallas_ring(x: jax.Array, axis_name: str, collective_id: int) -> jax.Array:
+def _pallas_ring(
+    x: jax.Array, axis_name: str, collective_id: int, *,
+    interpret: bool = False,
+) -> jax.Array:
+    """``interpret=True`` runs the kernel under Pallas's TPU interpret
+    mode (`pltpu.InterpretParams`), which SIMULATES the semaphores and
+    inter-chip RDMAs on CPU devices — the same kernel body, exercised
+    without hardware (tests/test_ops.py runs it on the CPU-sim mesh and
+    cross-checks against psum)."""
     return pl.pallas_call(
         functools.partial(_ring_kernel, axis_name=axis_name),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
@@ -85,6 +98,7 @@ def _pallas_ring(x: jax.Array, axis_name: str, collective_id: int) -> jax.Array:
             pltpu.SemaphoreType.DMA((2,)),
         ],
         compiler_params=pltpu.CompilerParams(collective_id=collective_id),
+        interpret=pltpu.InterpretParams() if interpret else False,
     )(x)
 
 
@@ -93,16 +107,24 @@ def ring_all_reduce_pallas(
     axis_name: str = DEFAULT_AXIS,
     *,
     collective_id: int = 0,
+    interpret: bool = False,
 ) -> jax.Array:
     """Ring all-reduce via explicit RDMA when running on ≥2 TPU chips;
-    falls back to the ppermute ring elsewhere (CPU simulation has no
-    inter-chip DMA to program).  The fallback WARNS loudly so a benchmark
-    or test can never silently report "RDMA kernel" numbers that ran the
+    falls back to the ppermute ring elsewhere (CPU execution has no real
+    inter-chip DMA).  The fallback WARNS loudly so a benchmark or test
+    can never silently report "RDMA kernel" numbers that ran the
     ppermute path instead.  Call inside shard_map over ``axis_name``
     (which must be the mesh's only axis for LOGICAL device ids to equal
-    ring positions)."""
+    ring positions).
+
+    ``interpret=True`` runs the ACTUAL kernel (semaphores, remote
+    copies) under Pallas's TPU interpret simulator on any platform — no
+    fallback, no warning; how the kernel is exercised without hardware.
+    """
     import warnings
 
+    if interpret:
+        return _pallas_ring(x, axis_name, collective_id, interpret=True)
     try:
         platform = jax.devices()[0].platform
     except RuntimeError:  # pragma: no cover
