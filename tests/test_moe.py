@@ -190,3 +190,147 @@ def test_top2_balance_loss_orders_routers():
     assert b_skew > b_mild
     # near-uniform routing sits near the perfect-balance value of 1.0
     np.testing.assert_allclose(b_mild, 1.0, atol=0.2)
+
+
+class TestMoELM:
+    """The MoE TransformerLM (VERDICT r4 #7): top-2 experts inside the
+    model, trained end-to-end with expert parallelism."""
+
+    def _lm(self, experts=2, balance=0.0, cap=8.0):
+        from tpu_dist import models
+
+        return models.TransformerLM(
+            vocab=32, dim=16, depth=2, heads=2, max_seq=16,
+            moe_experts=experts, moe_balance_weight=balance,
+            moe_capacity_factor=cap,  # ample: no token ever drops
+        )
+
+    def test_dense_moe_equals_mlp_when_experts_identical(self):
+        """With every expert holding the SAME weights, top-2 combine
+        (gates summing to 1) must reduce to the plain MLP block."""
+        from tpu_dist import models
+
+        lm = self._lm()
+        params, _ = lm.init(jax.random.key(0))
+        # make both experts identical
+        for pb in params["blocks"]:
+            pm = pb["moe"]
+            pm["up"] = jnp.stack([pm["up"][0]] * 2)
+            pm["down"] = jnp.stack([pm["down"][0]] * 2)
+        tokens = models.synthetic_tokens(4, 8, 32)
+        logits_moe, _ = lm.apply(params, {}, tokens)
+
+        # the equivalent dense-MLP model: same non-moe params, mlp
+        # weights = the (shared) expert weights.  The zoo MLP has
+        # biases; zero them to mirror the bias-free expert math.
+        mlp_lm = models.TransformerLM(
+            vocab=32, dim=16, depth=2, heads=2, max_seq=16
+        )
+        mlp_params, _ = mlp_lm.init(jax.random.key(0))
+        for pb_m, pb in zip(mlp_params["blocks"], params["blocks"]):
+            pm = pb["moe"]
+            pb_m["mlp"]["fc1"]["w"] = pm["up"][0]
+            pb_m["mlp"]["fc1"]["b"] = jnp.zeros_like(pb_m["mlp"]["fc1"]["b"])
+            pb_m["mlp"]["fc2"]["w"] = pm["down"][0]
+            pb_m["mlp"]["fc2"]["b"] = jnp.zeros_like(pb_m["mlp"]["fc2"]["b"])
+        for shared in ("embed", "ln", "pos"):
+            mlp_params[shared] = params[shared]
+        for pb_m, pb in zip(mlp_params["blocks"], params["blocks"]):
+            for k in ("ln1", "attn", "ln2"):
+                pb_m[k] = pb[k]
+        logits_mlp, _ = mlp_lm.apply(mlp_params, {}, tokens)
+        np.testing.assert_allclose(
+            np.asarray(logits_moe), np.asarray(logits_mlp),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_ep_forward_matches_dense_moe(self):
+        """The expert-parallel path (all_to_all dispatch, one expert per
+        rank) must equal the dense every-expert evaluation when capacity
+        is ample — same routing, same combine, no drops."""
+        from tpu_dist import models
+
+        N = 2
+        lm = self._lm(experts=N)
+        params, _ = lm.init(jax.random.key(1))
+        tokens = models.synthetic_tokens(4, 8, 32)
+        dense, _ = lm.apply(params, {}, tokens)
+
+        def fn(params, tokens):
+            r = comm.rank()
+            local = jax.lax.dynamic_slice_in_dim(tokens, r * 2, 2, 0)
+            logits, bal = lm.apply_moe_ep(params, local, comm.DEFAULT_AXIS)
+            return logits
+
+        out = np.asarray(run(fn, params, tokens, world=N))
+        gathered = np.concatenate([out[r] for r in range(N)], axis=0)
+        np.testing.assert_allclose(
+            gathered, np.asarray(dense), rtol=2e-4, atol=2e-4
+        )
+
+    def test_ep_training_matches_dense_trajectory(self):
+        """One EP training step (uniform data-axis pmean) == one dense
+        single-device step on the same global batch — the gradient
+        contract of apply_moe_ep, end to end through the step builder."""
+        from tpu_dist import models, parallel, train
+
+        N = 2
+        lm = self._lm(experts=N)
+        params, _ = lm.init(jax.random.key(2))
+        tokens = models.synthetic_tokens(8, 8, 32)
+        lr = 0.1
+
+        def dense_loss(p):
+            logits, _ = lm.apply(p, {}, tokens)
+            return models.lm_loss(logits, tokens)
+
+        g = jax.grad(dense_loss)(params)
+        expect = jax.tree.map(lambda p_, g_: p_ - lr * g_, params, g)
+
+        mesh = comm.make_mesh(N, ("data",), platform="cpu")
+
+        def loss_fn(p, batch, key):
+            (tok,) = batch
+            return lm.loss_moe_ep(p, tok, parallel.DATA_AXIS), {}
+
+        step = parallel.make_train_step(
+            loss_fn, train.sgd(lr), mesh, donate=False
+        )
+        p_rep = parallel.replicate(params, mesh)
+        o_rep = parallel.replicate(train.sgd(lr).init(params), mesh)
+        batch = parallel.shard_batch((tokens,), mesh)
+        p_rep, _, loss, _ = step(p_rep, o_rep, batch, jax.random.key(0))
+        assert np.isfinite(float(loss))
+        for e, got in zip(
+            jax.tree.leaves(expect), jax.tree.leaves(p_rep), strict=True
+        ):
+            np.testing.assert_allclose(
+                np.asarray(e), np.asarray(got), rtol=2e-4, atol=2e-5
+            )
+
+    def test_moe_trainer_mode_trains(self):
+        """LMTrainer(moe=True): loss falls over a few epochs and the
+        balance regularizer keeps gradients flowing to the router."""
+        from tpu_dist import models, train
+
+        N = 2
+        lm = self._lm(experts=N, balance=0.01)
+        mesh = comm.make_mesh(N, ("data",), platform="cpu")
+        cfg = train.LMTrainConfig(
+            epochs=3, global_batch=8, moe=True, log=lambda *_: None
+        )
+        trainer = train.LMTrainer(lm, mesh, cfg, optimizer=train.sgd(0.3))
+        windows = np.asarray(models.synthetic_tokens(16, 8, 32))
+        hist = trainer.fit(windows)
+        assert hist[-1].mean_loss < hist[0].mean_loss
+
+    def test_moe_trainer_world_mismatch_raises(self):
+        from tpu_dist import train
+        import pytest
+
+        lm = self._lm(experts=4)  # != data-axis size 2
+        mesh = comm.make_mesh(2, ("data",), platform="cpu")
+        with pytest.raises(ValueError, match="moe_experts"):
+            train.LMTrainer(
+                lm, mesh, train.LMTrainConfig(moe=True, log=lambda *_: None)
+            )

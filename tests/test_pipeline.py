@@ -317,3 +317,45 @@ def test_lm_interleaved_pipeline_matches_dense():
         np.testing.assert_allclose(
             out[r], np.asarray(expect), rtol=1e-4, atol=2e-4
         )
+
+
+@pytest.mark.parametrize("interleave", [1, 2])
+def test_lm_loss_pipeline_grad_contract(interleave):
+    """`TransformerLM.loss_pipeline`'s training contract (VERDICT r4 #6):
+    the psum over the pipe axis of the per-rank grad pytrees equals the
+    dense `lm_loss` gradient — block grads land once on the owning
+    stage's rank, the embedding-lookup grads once on rank 0, and the
+    replicated LN/vocab head's grads are 1/n per rank (the scaled
+    differentiable path), so everything sums to exactly dense."""
+    from tpu_dist import models
+
+    lm = models.TransformerLM(vocab=64, dim=32, depth=4, heads=4, max_seq=16)
+    params, _ = lm.init(jax.random.key(0))
+    tokens = models.synthetic_tokens(8, 8, 64)
+    world = 2
+
+    def dense_loss(p):
+        logits, _ = lm.apply(p, {}, tokens)
+        return models.lm_loss(logits, tokens)
+
+    g_dense = jax.grad(dense_loss)(params)
+
+    def fn(params, tokens):
+        g = jax.grad(
+            lambda p: lm.loss_pipeline(
+                p, tokens, comm.DEFAULT_AXIS,
+                n_microbatches=4, interleave=interleave,
+            )
+        )(params)
+        return jax.tree.map(
+            lambda a: jax.lax.psum(a, comm.DEFAULT_AXIS), g
+        )
+
+    got = run(fn, params, tokens, world=world)
+    for e, g in zip(
+        jax.tree.leaves(g_dense), jax.tree.leaves(got), strict=True
+    ):
+        g0 = np.asarray(g)[0]  # psum'd: identical on every rank
+        np.testing.assert_allclose(
+            np.asarray(e), g0, rtol=2e-4, atol=2e-5
+        )

@@ -72,12 +72,26 @@ class TransformerLM(Module):
         kv_heads: int | None = None,
         pos_embedding: str = "learned",
         remat: bool = False,
+        moe_experts: int = 0,
+        moe_capacity_factor: float = 2.0,
+        moe_balance_weight: float = 0.01,
     ):
         if pos_embedding not in ("learned", "rope"):
             raise ValueError(
                 f"pos_embedding must be 'learned' or 'rope', got "
                 f"{pos_embedding!r}"
             )
+        # moe_experts > 0 swaps every block's dense MLP for a top-2
+        # (GShard-style) mixture of experts: per block a router
+        # ``gate (d, E)`` plus expert-stacked ``up (E, d, 4d)`` /
+        # ``down (E, 4d, d)`` weights replace the ``mlp`` subtree.  The
+        # dense paths (`apply`, cached decode) evaluate every expert and
+        # combine the top-2 (exact, no capacity bound); `loss_moe_ep`
+        # trains with real expert parallelism (all_to_all dispatch over
+        # a mesh axis, `parallel.moe_mlp_top2`).
+        self.moe_experts = moe_experts
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_balance_weight = moe_balance_weight
         # Rematerialize each block's forward during backward
         # (jax.checkpoint): activation HBM drops from O(depth · B·S·d)
         # to O(B·S·d) + one extra forward of FLOPs — the standard TPU
@@ -110,11 +124,50 @@ class TransformerLM(Module):
             ],
             "ln": self.ln.init(ks[-1], tok_shape)[0],
         }
+        if self.moe_experts:
+            E, d, hdim = self.moe_experts, self.dim, 4 * self.dim
+            for pb, k in zip(params["blocks"], ks[2:]):
+                kg, ku, kd = jax.random.split(jax.random.fold_in(k, 7), 3)
+                del pb["mlp"]
+                pb["moe"] = {
+                    "gate": jax.random.normal(kg, (d, E)) * 0.02,
+                    "up": jax.random.normal(ku, (E, d, hdim)) / jnp.sqrt(d),
+                    "down": jax.random.normal(kd, (E, hdim, d))
+                    / jnp.sqrt(hdim),
+                }
         if self.pos_embedding == "learned":
             params["pos"] = (
                 jax.random.normal(ks[1], (1, self.max_seq, self.dim)) * 0.02
             )
         return params, {}
+
+    def _moe_dense(self, pm, x):
+        """Exact dense evaluation of the top-2 MoE over ``(..., d)``
+        activations: every expert computes every token, the router's
+        top-2 (renormalized, GShard-style) combine selects — no capacity
+        bound, so this is the drop-free reference the EP path
+        (`loss_moe_ep` with ample capacity) matches to fp tolerance."""
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, x.shape[-1])
+        scores = x2 @ pm["gate"]  # (T, E)
+        probs = jax.nn.softmax(scores, axis=-1)
+        top2_p, top2_e = jax.lax.top_k(probs, 2)
+        gates = top2_p / jnp.maximum(top2_p.sum(-1, keepdims=True), 1e-9)
+        hidden = jax.nn.gelu(jnp.einsum("td,edh->eth", x2, pm["up"]))
+        y_all = jnp.einsum("eth,ehd->etd", hidden, pm["down"])  # (E, T, d)
+        t_idx = jnp.arange(x2.shape[0])
+        y = (
+            gates[:, 0, None] * y_all[top2_e[:, 0], t_idx]
+            + gates[:, 1, None] * y_all[top2_e[:, 1], t_idx]
+        )
+        return y.reshape(*lead, x.shape[-1])
+
+    def _mlp_or_moe(self, blk, pb, x):
+        """The feed-forward half of a block: dense MLP, or the dense
+        (every-expert) MoE evaluation for ``moe_experts > 0`` models."""
+        if self.moe_experts:
+            return self._moe_dense(pb["moe"], x)
+        return blk.mlp.apply(pb["mlp"], {}, x)[0]
 
     def _trunk(self, params, tokens, *, pos_offset=0):
         b, s = tokens.shape
@@ -137,13 +190,20 @@ class TransformerLM(Module):
         batches)."""
         h = self._trunk(params, tokens)
         for blk, pb in zip(self.blocks, params["blocks"]):
-            if self.remat:
-                def block_fn(pb_, h_, blk=blk):
+            def block_fn(pb_, h_, blk=blk):
+                if not self.moe_experts:
                     return blk.apply(pb_, {}, h_, train=train,
                                      mask=attn_mask)[0]
+                x1, _ = blk.ln1.apply(pb_["ln1"], {}, h_)
+                o, _ = blk.attn.apply(pb_["attn"], {}, x1, mask=attn_mask)
+                h_ = h_ + o
+                x2, _ = blk.ln2.apply(pb_["ln2"], {}, h_)
+                return h_ + self._mlp_or_moe(blk, pb_, x2)
+
+            if self.remat:
                 h = jax.checkpoint(block_fn)(pb, h)
             else:
-                h, _ = blk.apply(pb, {}, h, train=train, mask=attn_mask)
+                h = block_fn(pb, h)
         h, _ = self.ln.apply(params["ln"], {}, h)
         logits = h @ params["embed"]["table"].T
         return logits, state
@@ -177,8 +237,7 @@ class TransformerLM(Module):
             )
             h = h + o
             x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
-            m, _ = blk.mlp.apply(pb["mlp"], {}, x2)
-            h = h + m
+            h = h + self._mlp_or_moe(blk, pb, x2)
             new_cache.append({"k": ck, "v": cv})
         h, _ = self.ln.apply(params["ln"], {}, h)
         logits = h @ params["embed"]["table"].T
@@ -540,7 +599,7 @@ class TransformerLM(Module):
 
     def apply_pipeline(
         self, params, tokens, axis_name, *,
-        n_microbatches: int = 4, interleave: int = 1,
+        n_microbatches: int = 4, interleave: int = 1, head_params=None,
     ):
         """Pipeline-parallel forward for use INSIDE shard_map over a
         ``pipe`` axis: rank r runs ``depth / n`` consecutive blocks as
@@ -556,7 +615,12 @@ class TransformerLM(Module):
         ``depth/(n·v)`` blocks (chunk c = global stage ``c·n + r``),
         cutting the bubble from ``(n-1)/(M+n-1)`` to
         ``(n-1)/(M·v+n-1)``; ``n_microbatches`` must then be a multiple
-        of the pipe world."""
+        of the pipe world.
+
+        ``head_params``: optional ``(ln_params, embed_table)`` override
+        for the replicated LN/vocab head — `loss_pipeline` passes
+        gradient-scaled copies so the training gradient contract holds;
+        forward values are unchanged."""
         from jax import lax
 
         from tpu_dist.parallel.pipeline import (
@@ -611,8 +675,112 @@ class TransformerLM(Module):
                 lambda p, a: run_blocks(p, a, pc), chunks_local, h,
                 n_microbatches=n_microbatches, axis_name=axis_name,
             )
+        ln_p, table = (
+            head_params
+            if head_params is not None
+            else (params["ln"], params["embed"]["table"])
+        )
+        h, _ = self.ln.apply(ln_p, {}, h)
+        return h @ table.T
+
+    def loss_pipeline(
+        self, params, tokens, axis_name, *,
+        n_microbatches: int = 4, interleave: int = 1,
+    ):
+        """Pipeline-parallel TRAINING loss for use INSIDE shard_map over
+        a ``pipe`` axis (`parallel.make_stateful_train_step` with
+        ``grad_psum_axes=(axis_name,)``).
+
+        Gradient contract: the psum over ``axis_name`` of the per-rank
+        grad pytrees equals the dense `lm_loss` gradient (tested).  The
+        pieces: block grads land only on the rank owning each stage
+        (`parallel.pipeline_apply`'s convention — summing recovers the
+        sequential grads); the embedding-lookup/positional grads land
+        only on rank 0 (it alone injects microbatches); the LN/vocab
+        head runs REPLICATED on every rank, so its params enter with
+        their differentiable path scaled 1/n (forward value unchanged)
+        — n identical head grads then psum back to exactly the dense
+        grad, and the weight-tied embedding table gets its lookup and
+        head contributions each counted once."""
+        from jax import lax
+
+        n = lax.axis_size(axis_name)
+
+        def scale(a):
+            return a / n + lax.stop_gradient(a * (n - 1) / n)
+
+        head = (
+            jax.tree.map(scale, params["ln"]),
+            scale(params["embed"]["table"]),
+        )
+        logits = self.apply_pipeline(
+            params, tokens, axis_name,
+            n_microbatches=n_microbatches, interleave=interleave,
+            head_params=head,
+        )
+        return lm_loss(logits.astype(jnp.float32), tokens)
+
+    def apply_moe_ep(self, params, tokens_local, axis_name):
+        """Expert-parallel forward for use INSIDE shard_map: the batch
+        is sharded over ``axis_name`` (attention is per-sample, so batch
+        sharding is exact) and each rank owns ONE expert per block —
+        every MoE layer dispatches its local tokens to their routed
+        experts with one ``all_to_all`` each way
+        (`parallel.moe_mlp_top2`).  Requires ``moe_experts == axis
+        size``.  Params enter replicated (each rank slices its expert
+        row), which makes the gradient contract a UNIFORM pmean over
+        ``axis_name``: shared params replicate per-rank full grads, and
+        each expert's grads appear on exactly one rank (the psum inside
+        pmean sums them once, the 1/n is the global-batch mean).
+
+        Returns ``(logits_local, balance)`` — the mean GShard balance
+        loss over blocks (its gradient flows into the routers).
+        """
+        from jax import lax
+
+        from tpu_dist.parallel.moe import moe_mlp_top2
+
+        n = lax.axis_size(axis_name)
+        if self.moe_experts != n:
+            raise ValueError(
+                f"moe_experts {self.moe_experts} != expert-axis size {n} "
+                "(one expert per rank)"
+            )
+        r = lax.axis_index(axis_name)
+        b, s = tokens_local.shape
+        h = self._trunk(params, tokens_local)
+        balances = []
+        for blk, pb in zip(self.blocks, params["blocks"]):
+            x1, _ = blk.ln1.apply(pb["ln1"], {}, h)
+            o, _ = blk.attn.apply(pb["attn"], {}, x1)
+            h = h + o
+            x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
+            pm = pb["moe"]
+            y2, stats = moe_mlp_top2(
+                x2.reshape(b * s, self.dim),
+                pm["gate"],
+                lax.dynamic_index_in_dim(pm["up"], r, 0, keepdims=False),
+                lax.dynamic_index_in_dim(pm["down"], r, 0, keepdims=False),
+                axis_name=axis_name,
+                capacity_factor=self.moe_capacity_factor,
+            )
+            h = h + y2.reshape(b, s, self.dim)
+            balances.append(stats["balance_loss"])
         h, _ = self.ln.apply(params["ln"], {}, h)
-        return h @ params["embed"]["table"].T
+        logits = h @ params["embed"]["table"].T
+        return logits, jnp.mean(jnp.stack(balances))
+
+    def loss_moe_ep(self, params, tokens_local, axis_name):
+        """Expert-parallel training loss: local next-token loss plus
+        ``moe_balance_weight ×`` the mean balance loss (the router
+        regularizer keeping experts utilized).  pmean over ``axis_name``
+        == the global-batch loss; uniform-pmean gradient contract per
+        `apply_moe_ep` (tested == dense in test_moe.py)."""
+        logits, balance = self.apply_moe_ep(params, tokens_local, axis_name)
+        return (
+            lm_loss(logits.astype(jnp.float32), tokens_local)
+            + self.moe_balance_weight * balance
+        )
 
     def apply_seq_parallel(self, params, tokens_local, axis_name, *,
                            flash: bool = False, interpret: bool = False,

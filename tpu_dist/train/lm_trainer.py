@@ -41,11 +41,13 @@ class LMTrainConfig:
     # ZeRO-3: params/grads/opt state sharded 1/n (LMs are stateless so
     # the step swap is transparent; checkpoints switch to the sharded
     # format; val perplexity / generate gather params as needed).
-    # Not combinable with accum_steps > 1.
+    # Composes with accum_steps (microbatch scan inside the sharded
+    # step) and with tensor_parallel (HSDP: grad_pmean_axes applies the
+    # TP gradient contract before the data-axis reduce-scatter).
     fsdp: bool = False
     # ZeRO-1: params replicated, optimizer state sharded 1/n.  Mutually
-    # exclusive with fsdp; same sharded checkpoint format and
-    # accum_steps restriction.
+    # exclusive with fsdp; same sharded checkpoint format; composes
+    # with accum_steps (not with tensor_parallel — use fsdp for that).
     zero1: bool = False
     # Tensor parallelism over a 2-D (data x model) mesh: "psum" = the
     # classic Megatron layout (replicated activations, two psums per
@@ -53,10 +55,39 @@ class LMTrainConfig:
     # Megatron-SP collective-matmul layout (activations sequence-sharded
     # between sublayers, all-gathers/reduce-scatters folded into the
     # matmuls — loss_tensor_parallel_sp).  Params stay replicated either
-    # way, so checkpoints/eval/generate are unchanged.  Mutually
-    # exclusive with fsdp/zero1.
+    # way (row-sharded when composed with fsdp), so checkpoints/eval/
+    # generate are unchanged.
     tensor_parallel: str | None = None
     model_axis: str = "model"
+    # Sequence/context-parallel TRAINING over a (data x seq) mesh:
+    # "ring" = ring attention (K/V blocks rotate via ppermute while each
+    # rank holds its sequence shard), "ulysses" = all-to-all head
+    # resharding.  Tokens arrive (B/dp, S/seq); the boundary-correct
+    # `lm_loss_seq_parallel` makes the seq-axis pmean equal the dense
+    # loss.  Params replicated.  Mutually exclusive with the other
+    # model-sharding modes.
+    sequence_parallel: str | None = None
+    seq_axis: str = "seq"
+    # Pipeline-parallel training over a (data x pipe) mesh: "gpipe" =
+    # the GPipe microbatch schedule, "1f1b" = the interleaved Megatron
+    # schedule with `pipe_interleave` chunks per rank.  Blocks are
+    # staged over the pipe axis inside the compiled step
+    # (`TransformerLM.loss_pipeline`, grads psum'd over 'pipe'); params
+    # replicated, so checkpoints/eval/generate are unchanged.  Mutually
+    # exclusive with the other model-sharding modes.
+    pipeline: str | None = None
+    pipe_axis: str = "pipe"
+    pipe_microbatches: int = 4
+    pipe_interleave: int = 2
+    # Expert-parallel MoE training: the model must be built with
+    # ``moe_experts == data-axis size`` (one expert per rank); the batch
+    # shards over 'data' as usual and every MoE layer all_to_all-dispatches
+    # tokens to their routed experts (`TransformerLM.loss_moe_ep`, with
+    # the balance-loss regularizer).  The gradient contract is the
+    # uniform data-axis pmean the step already applies, so this composes
+    # with fsdp/zero1/accum_steps; mutually exclusive with the other
+    # model-sharding modes.
+    moe: bool = False
     log: Callable[[str], None] = print
 
 
@@ -90,24 +121,68 @@ class LMTrainer:
         self._sharded_mode = self.config.fsdp or self.config.zero1
         if self.config.fsdp and self.config.zero1:
             raise ValueError("fsdp and zero1 are mutually exclusive")
-        if self._sharded_mode and self.config.accum_steps != 1:
-            raise ValueError("accum_steps > 1 is not supported with fsdp/zero1")
         tp = self.config.tensor_parallel
+        sp = self.config.sequence_parallel
+        pp = self.config.pipeline
+        moe = self.config.moe
+        if sum(x is not None for x in (tp, sp, pp)) + bool(moe) > 1:
+            raise ValueError(
+                "tensor_parallel, sequence_parallel, pipeline, and moe "
+                "are mutually exclusive trainer modes"
+            )
+        if moe:
+            world_data = mesh.shape.get(parallel.DATA_AXIS)
+            if getattr(lm, "moe_experts", 0) != world_data:
+                raise ValueError(
+                    f"moe mode needs lm.moe_experts == data-axis size "
+                    f"({world_data}), got {getattr(lm, 'moe_experts', 0)}"
+                )
         if tp is not None:
             if tp not in ("psum", "sp"):
                 raise ValueError(
                     f"tensor_parallel must be 'psum' or 'sp', got {tp!r}"
                 )
-            if self._sharded_mode:
+            if self.config.zero1:
                 raise ValueError(
-                    "tensor_parallel is not combinable with fsdp/zero1 "
-                    "here (compose via parallel.make_fsdp_train_step's "
-                    "grad_pmean_axes instead)"
+                    "tensor_parallel composes with fsdp (HSDP), not "
+                    "zero1 — set fsdp=True for the sharded-state variant"
                 )
             if self.config.model_axis not in mesh.axis_names:
                 raise ValueError(
                     f"tensor_parallel needs a {self.config.model_axis!r} "
                     f"mesh axis; mesh has {mesh.axis_names}"
+                )
+        if sp is not None:
+            if sp not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"sequence_parallel must be 'ring' or 'ulysses', "
+                    f"got {sp!r}"
+                )
+            if self._sharded_mode:
+                raise ValueError(
+                    "sequence_parallel is not combinable with fsdp/zero1 "
+                    "in the trainer (compose via "
+                    "parallel.make_fsdp_train_step's batch_spec instead)"
+                )
+            if self.config.seq_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"sequence_parallel needs a {self.config.seq_axis!r} "
+                    f"mesh axis; mesh has {mesh.axis_names}"
+                )
+        if pp is not None:
+            if pp not in ("gpipe", "1f1b"):
+                raise ValueError(
+                    f"pipeline must be 'gpipe' or '1f1b', got {pp!r}"
+                )
+            if self._sharded_mode:
+                raise ValueError(
+                    "pipeline is not combinable with fsdp/zero1 in the "
+                    "trainer (stage params already partition the model)"
+                )
+            if self.config.pipe_axis not in mesh.axis_names:
+                raise ValueError(
+                    f"pipeline needs a {self.config.pipe_axis!r} mesh "
+                    f"axis; mesh has {mesh.axis_names}"
                 )
         params, _ = lm.init(jax.random.key(self.config.seed))
         from tpu_dist.utils.debug import assert_no_aliasing
@@ -128,41 +203,79 @@ class LMTrainer:
                 p,
             )
 
-        def loss_fn(p, s, batch, key):
-            (tokens,) = batch
+        def mode_loss(p, tokens):
+            """The per-rank loss for the active model-sharding mode."""
             if tp == "sp":
                 # tokens arrive (B/dp, S/tp): batch AND sequence sharded
-                return (
-                    self.lm.loss_tensor_parallel_sp(
-                        cast(p), tokens, self.config.model_axis
-                    ),
-                    ({}, {}),
+                return self.lm.loss_tensor_parallel_sp(
+                    cast(p), tokens, self.config.model_axis
                 )
             if tp == "psum":
-                return (
-                    self.lm.loss_tensor_parallel(
-                        cast(p), tokens, self.config.model_axis
+                return self.lm.loss_tensor_parallel(
+                    cast(p), tokens, self.config.model_axis
+                )
+            if sp is not None:
+                # tokens arrive (B/dp, S/seq): the boundary-correct loss
+                logits = self.lm.apply_seq_parallel(
+                    cast(p), tokens, self.config.seq_axis, attention=sp
+                )
+                from tpu_dist.models.transformer_lm import (
+                    lm_loss_seq_parallel,
+                )
+
+                return lm_loss_seq_parallel(
+                    logits.astype(jnp.float32), tokens, self.config.seq_axis
+                )
+            if pp is not None:
+                return self.lm.loss_pipeline(
+                    cast(p), tokens, self.config.pipe_axis,
+                    n_microbatches=self.config.pipe_microbatches,
+                    interleave=(
+                        self.config.pipe_interleave if pp == "1f1b" else 1
                     ),
-                    ({}, {}),
+                )
+            if moe:
+                return self.lm.loss_moe_ep(
+                    cast(p), tokens, parallel.DATA_AXIS
                 )
             logits, _ = self.lm.apply(cast(p), {}, tokens)
-            return lm_loss(logits.astype(jnp.float32), tokens), ({}, {})
+            return lm_loss(logits.astype(jnp.float32), tokens)
 
+        def loss_fn(p, s, batch, key):
+            (tokens,) = batch
+            return mode_loss(p, tokens), ({}, {})
+
+        from jax.sharding import PartitionSpec as P
+
+        # One source of truth for how token batches shard: over batch
+        # AND sequence for the Megatron-SP and sequence-parallel modes,
+        # batch only otherwise.  fit()/both step builders all use this.
+        self._batch_spec = (
+            P(parallel.DATA_AXIS, self.config.model_axis)
+            if tp == "sp"
+            else P(parallel.DATA_AXIS, self.config.seq_axis)
+            if sp is not None
+            else None
+        )
         if self._sharded_mode:
-
             def fsdp_loss(p, batch, key):
                 (tokens,) = batch
-                logits, _ = self.lm.apply(cast(p), {}, tokens)
-                return lm_loss(logits.astype(jnp.float32), tokens), {}
+                return mode_loss(p, tokens), {}
 
-            make = (
-                parallel.make_fsdp_train_step
-                if self.config.fsdp
-                else parallel.make_zero1_train_step
-            )
-            fstep, p_sh, o_sh = make(
-                fsdp_loss, self.optimizer, mesh, params
-            )
+            if self.config.fsdp:
+                fstep, p_sh, o_sh = parallel.make_fsdp_train_step(
+                    fsdp_loss, self.optimizer, mesh, params,
+                    accum_steps=self.config.accum_steps,
+                    grad_pmean_axes=(
+                        (self.config.model_axis,) if tp is not None else ()
+                    ),
+                    batch_spec=self._batch_spec,
+                )
+            else:
+                fstep, p_sh, o_sh = parallel.make_zero1_train_step(
+                    fsdp_loss, self.optimizer, mesh, params,
+                    accum_steps=self.config.accum_steps,
+                )
             assert_no_aliasing(p_sh, o_sh)
             self.params, self.opt_state = p_sh, o_sh
             self._param_template = jax.tree.map(
@@ -175,30 +288,25 @@ class LMTrainer:
 
             self.step = fsdp_step
         else:
-            from jax.sharding import PartitionSpec as P
-
+            extra = ()
+            if tp is not None:
+                extra = (self.config.model_axis,)
+            elif sp is not None:
+                extra = (self.config.seq_axis,)
             self.params = parallel.replicate(params, mesh)
             self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
             assert_no_aliasing(self.params, self.opt_state)
             self.step = parallel.make_stateful_train_step(
                 loss_fn, self.optimizer, mesh,
                 accum_steps=self.config.accum_steps,
-                extra_grad_axes=(
-                    (self.config.model_axis,) if tp is not None else ()
+                extra_grad_axes=extra,
+                # pipeline: per-rank grads PARTITION the dense gradient
+                # over stages — sum, don't average
+                grad_psum_axes=(
+                    (self.config.pipe_axis,) if pp is not None else ()
                 ),
-                batch_spec=(
-                    P(parallel.DATA_AXIS, self.config.model_axis)
-                    if tp == "sp"
-                    else None
-                ),
+                batch_spec=self._batch_spec,
             )
-        from jax.sharding import PartitionSpec as _P
-
-        self._batch_spec = (
-            _P(parallel.DATA_AXIS, self.config.model_axis)
-            if tp == "sp"
-            else None
-        )
         self._model_state = parallel.replicate({}, mesh)
 
     def _full_params(self):
