@@ -236,7 +236,8 @@ def test_multiprocess_psum_end_to_end():
         [sys.executable, str(REPO / "tests" / "multiproc_worker.py")],
         capture_output=True,
         text=True,
-        timeout=300,
+        timeout=600,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "MULTIPROCESS OK" in proc.stdout
+    assert "MULTIPROCESS TRAIN 4-PROC OK" in proc.stdout
