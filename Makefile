@@ -8,7 +8,8 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
 .PHONY: test ptp gather allreduce train bench runtime train-image \
         kernels decode serve lm-train overlap parity figures \
-        scaling multiproc longcontext train-lm generate docs demos
+        scaling multiproc longcontext train-lm train-lm-modes generate \
+        docs demos
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -45,6 +46,9 @@ runtime:
 
 train-lm:
 	cd demos && $(PY) train_lm.py $(DEMOFLAGS)
+
+train-lm-modes:  # MODE=dp|fsdp|zero1|tp_psum|tp_sp|fsdp_tp_sp|seq_ring|seq_ulysses|pipe_gpipe|pipe_1f1b|moe
+	cd demos && $(PY) train_lm_modes.py --mode $(or $(MODE),dp) --platform $(PLATFORM)
 
 generate:
 	cd demos && $(PY) generate.py --platform $(PLATFORM)

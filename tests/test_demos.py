@@ -104,3 +104,31 @@ def test_train_lm_tensor_parallel_cli():
         for l in out.splitlines() if l.lstrip().startswith("step")
     ]
     assert len(losses) > 2 and losses[-1] < losses[0], out
+
+
+def test_train_lm_modes_demo():
+    """The unified-surface demo: a non-trivial mode (pipeline 1F1B)
+    trains with decreasing loss from the one-config entry point."""
+    out = run_demo(
+        "train_lm_modes.py", "--mode", "pipe_1f1b", "--platform", "cpu",
+        "--epochs", "2", timeout=420,
+    )
+    assert "mode=pipe_1f1b" in out
+    assert "done: loss" in out
+    import re
+
+    m = re.search(r"loss ([\d.]+) -> ([\d.]+)", out)
+    assert m and float(m.group(2)) < float(m.group(1)), out
+
+
+def test_train_lm_modes_rejects_unknown_mode():
+    import subprocess as sp
+
+    proc = sp.run(
+        [sys.executable, "train_lm_modes.py", "--mode", "bogus",
+         "--platform", "cpu"],
+        capture_output=True, text=True, timeout=120,
+        cwd=DEMOS,
+    )
+    assert proc.returncode != 0
+    assert "--mode must be one of" in proc.stderr

@@ -128,3 +128,54 @@ def test_mode_trains_to_dense_trajectory(mode, dense_params, tmp_path):
         strict=True,
     ):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_moe_mode_trains_to_dense_trajectory(tmp_path):
+    """The 15th mode: LMTrainConfig(moe=True) — expert-parallel training
+    of the MoE model must match the SAME model trained densely (the
+    every-expert dense path on one device), and its checkpoint must
+    restore.  Balance weight 0 and ample capacity so EP == dense
+    exactly; the balance term's effect is covered in test_moe.py."""
+    def moe_lm():
+        return models.TransformerLM(
+            vocab=VOCAB, dim=DIM, depth=2, heads=HEADS, max_seq=SEQ,
+            moe_experts=2, moe_capacity_factor=8.0,
+            moe_balance_weight=0.0,
+        )
+
+    windows = _windows()
+    # dense reference: 1-device mesh, plain DP config — lm.apply routes
+    # the SAME params through the dense every-expert MoE evaluation
+    dense_mesh = comm.make_mesh(1, ("data",), platform="cpu")
+    dense = train.LMTrainer(
+        moe_lm(), dense_mesh,
+        train.LMTrainConfig(epochs=1, global_batch=GB, log=lambda *_: None),
+        optimizer=train.sgd(0.05),
+    )
+    dense.fit(windows)
+    expect = jax.tree.map(np.asarray, dense.params)
+
+    ep_mesh = comm.make_mesh(2, ("data",), platform="cpu")
+    cfg = train.LMTrainConfig(
+        epochs=1, global_batch=GB, moe=True, log=lambda *_: None
+    )
+    trainer = train.LMTrainer(
+        moe_lm(), ep_mesh, cfg, optimizer=train.sgd(0.05)
+    )
+    trainer.fit(windows, checkpoint_dir=str(tmp_path))
+    got = jax.tree.map(np.asarray, trainer.params)
+    for e, g in zip(
+        jax.tree.leaves(expect), jax.tree.leaves(got), strict=True
+    ):
+        np.testing.assert_allclose(e, g, rtol=2e-3, atol=2e-4)
+
+    fresh = train.LMTrainer(
+        moe_lm(), ep_mesh, cfg, optimizer=train.sgd(0.05)
+    )
+    assert fresh.restore(f"{tmp_path}/lm_ckpt_0.npz") == 1
+    for a, b in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, fresh.params)),
+        jax.tree.leaves(got),
+        strict=True,
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
