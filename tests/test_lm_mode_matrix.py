@@ -63,6 +63,22 @@ MODES = {
         (1, 2), ("data", "pipe"),
         {"pipeline": "1f1b", "pipe_microbatches": 4, "pipe_interleave": 2},
     ),
+    # 2-D compositions: a REAL data axis alongside the model-sharding
+    # axis, and accumulation stacked on model sharding — the matrix is
+    # about compositions, not just single strategies.
+    "dp2_pipe_gpipe": (
+        (2, 2), ("data", "pipe"),
+        {"pipeline": "gpipe", "pipe_microbatches": 2},
+    ),
+    "dp2_seq_ring": ((2, 2), ("data", "seq"), {"sequence_parallel": "ring"}),
+    "tp_psum_accum": (
+        (1, 2), ("data", "model"),
+        {"tensor_parallel": "psum", "accum_steps": 2},
+    ),
+    "fsdp_tp_sp_accum": (
+        (2, 2), ("data", "model"),
+        {"fsdp": True, "tensor_parallel": "sp", "accum_steps": 2},
+    ),
 }
 
 
