@@ -79,6 +79,14 @@ MODES = {
         (2, 2), ("data", "model"),
         {"fsdp": True, "tensor_parallel": "sp", "accum_steps": 2},
     ),
+    "zero1_tp_psum": (
+        (2, 2), ("data", "model"),
+        {"zero1": True, "tensor_parallel": "psum"},
+    ),
+    "zero1_tp_sp": (
+        (2, 2), ("data", "model"),
+        {"zero1": True, "tensor_parallel": "sp"},
+    ),
 }
 
 

@@ -119,11 +119,11 @@ def test_tensor_parallel_validations(mesh):
         _trainer(mesh, tensor_parallel="megatron")
     with pytest.raises(ValueError, match="mesh axis"):
         _trainer(mesh, tensor_parallel="sp")  # 1-D data mesh: no 'model'
-    mesh2d = comm.make_mesh((2, 2), ("data", "model"), platform="cpu")
-    # tensor_parallel x fsdp is the supported HSDP composition now
-    # (test_lm_mode_matrix covers it training == dense); zero1 is not
-    with pytest.raises(ValueError, match="zero1"):
-        _trainer(mesh2d, tensor_parallel="sp", zero1=True)
+    # tensor_parallel x fsdp (HSDP) and x zero1 are supported
+    # compositions now — test_lm_mode_matrix covers both training ==
+    # dense; fsdp+zero1 together stays refused
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _trainer(mesh, fsdp=True, zero1=True)
 
 
 def test_tensor_parallel_bf16_matches_dense_bf16(mesh, windows):

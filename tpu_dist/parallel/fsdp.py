@@ -89,6 +89,24 @@ def _accumulate_grads(loss_grad_fn, params, batch, key, accum_steps: int):
     return grads, loss, aux
 
 
+def _apply_grad_contract(grads, loss, aux, axis_name, grad_pmean_axes):
+    """The TP-composition tail shared by the ZeRO step builders: pmean
+    grads over the extra model axes (the tensor-parallel gradient
+    contract — the model-axis mean of a model-sharded loss's grads
+    equals the dense gradient), then reduce loss/aux over ALL axes so
+    their replicated out_specs are honest."""
+    if grad_pmean_axes:
+        grads = jax.tree.map(lambda g: lax.pmean(g, grad_pmean_axes), grads)
+    all_axes = (axis_name, *grad_pmean_axes)
+    return grads, lax.pmean(loss, all_axes), _pmean_float_leaves(aux, all_axes)
+
+
+def _batch_in_spec(batch_spec, axis_name: str):
+    """The batch partition spec (default: leading axis over the data
+    axis) — one definition for both ZeRO builders."""
+    return batch_spec if batch_spec is not None else P(axis_name)
+
+
 def _spec_of(axis_name: str):
     """Per-leaf partition spec: (n, k) leaves sharded over the axis,
     scalar leaves (e.g. a schedule step counter) replicated."""
@@ -288,20 +306,14 @@ def make_fsdp_train_step(
             grads, loss, aux = _accumulate_grads(
                 vg, full, batch, key, accum_steps
             )
-        if grad_pmean_axes:  # e.g. the TP model axis (gradient contract)
-            grads = jax.tree.map(
-                lambda g: lax.pmean(g, grad_pmean_axes), grads
-            )
+        grads, loss, aux = _apply_grad_contract(
+            grads, loss, aux, axis_name, grad_pmean_axes
+        )
         gshards = _reduce_scatter_grads(grads, n, axis_name)
         new_shards, new_opt = opt_update(
             local_shards, gshards, opt_state, axis_name
         )
-        # aux mirrors make_stateful_train_step's contract: float leaves
-        # are cross-rank means, not one rank's local value.  Loss/aux
-        # reduce over the extra axes too so the P() out_spec is honest.
-        all_axes = (axis_name, *grad_pmean_axes)
-        aux = _pmean_float_leaves(aux, all_axes)
-        return new_shards, new_opt, lax.pmean(loss, all_axes), aux
+        return new_shards, new_opt, loss, aux
 
     p_specs = jax.tree.map(_spec_of(axis_name), sharded_params)
     o_specs = jax.tree.map(_spec_of(axis_name), opt_state)
@@ -309,9 +321,7 @@ def make_fsdp_train_step(
         spmd_step,
         mesh=mesh,
         in_specs=(
-            p_specs, o_specs,
-            batch_spec if batch_spec is not None else P(axis_name),
-            P(),
+            p_specs, o_specs, _batch_in_spec(batch_spec, axis_name), P(),
         ),
         out_specs=(p_specs, o_specs, P(), P()),
         check_vma=False,
@@ -343,6 +353,8 @@ def make_zero1_train_step(
     axis_name: str = DATA_AXIS,
     donate: bool = True,
     accum_steps: int = 1,
+    grad_pmean_axes: tuple[str, ...] = (),
+    batch_spec=None,
 ):
     """ZeRO-1: replicated parameters, SHARDED optimizer state — the
     middle point between replicated DP and FSDP/ZeRO-3.
@@ -359,8 +371,12 @@ def make_zero1_train_step(
     sharding is implicit here: the reduce-scatter means full gradients
     never persist — XLA frees them within the step.)
 
-    ``accum_steps``: microbatch gradient accumulation, identical
-    contract to `make_fsdp_train_step`.
+    ``accum_steps``, ``grad_pmean_axes``, and ``batch_spec`` carry the
+    same contracts as `make_fsdp_train_step` — in particular TP×ZeRO-1:
+    pass ``grad_pmean_axes=('model',)`` with a tensor-parallel loss on a
+    (data × model) mesh (and ``batch_spec=P('data','model')`` for the
+    SP layout) and the optimizer state shards over 'data' while the
+    loss runs model-sharded.
 
     Returns ``(step, replicated_params, sharded_opt_state)`` with
     ``step(params, opt_state, batch, key) -> (params, opt_state, loss,
@@ -401,15 +417,17 @@ def make_zero1_train_step(
             grads, loss, aux = _accumulate_grads(
                 vg, full_params, batch, key, accum_steps
             )
+        grads, loss, aux = _apply_grad_contract(
+            grads, loss, aux, axis_name, grad_pmean_axes
+        )
         gshards = _reduce_scatter_grads(grads, n, axis_name)
         new_rows, new_opt = opt_update(
             local_rows(full_params), gshards, opt_state, axis_name
         )
-        aux = _pmean_float_leaves(aux, axis_name)
         return (
             _unshard_rows(new_rows, template, axis_name),
             new_opt,
-            lax.pmean(loss, axis_name),
+            loss,
             aux,
         )
 
@@ -417,7 +435,9 @@ def make_zero1_train_step(
     mapped = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(P(), o_specs, P(axis_name), P()),
+        in_specs=(
+            P(), o_specs, _batch_in_spec(batch_spec, axis_name), P(),
+        ),
         out_specs=(P(), o_specs, P(), P()),
         check_vma=False,
     )

@@ -47,7 +47,7 @@ class LMTrainConfig:
     fsdp: bool = False
     # ZeRO-1: params replicated, optimizer state sharded 1/n.  Mutually
     # exclusive with fsdp; same sharded checkpoint format; composes
-    # with accum_steps (not with tensor_parallel — use fsdp for that).
+    # with accum_steps and tensor_parallel (like fsdp).
     zero1: bool = False
     # Tensor parallelism over a 2-D (data x model) mesh: "psum" = the
     # classic Megatron layout (replicated activations, two psums per
@@ -141,11 +141,6 @@ class LMTrainer:
             if tp not in ("psum", "sp"):
                 raise ValueError(
                     f"tensor_parallel must be 'psum' or 'sp', got {tp!r}"
-                )
-            if self.config.zero1:
-                raise ValueError(
-                    "tensor_parallel composes with fsdp (HSDP), not "
-                    "zero1 — set fsdp=True for the sharded-state variant"
                 )
             if self.config.model_axis not in mesh.axis_names:
                 raise ValueError(
@@ -275,6 +270,10 @@ class LMTrainer:
                 fstep, p_sh, o_sh = parallel.make_zero1_train_step(
                     fsdp_loss, self.optimizer, mesh, params,
                     accum_steps=self.config.accum_steps,
+                    grad_pmean_axes=(
+                        (self.config.model_axis,) if tp is not None else ()
+                    ),
+                    batch_spec=self._batch_spec,
                 )
             assert_no_aliasing(p_sh, o_sh)
             self.params, self.opt_state = p_sh, o_sh
