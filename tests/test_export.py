@@ -27,10 +27,17 @@ def test_forward_artifact_roundtrip(tmp_path):
         )
 
 
-def test_generate_artifact_roundtrip(tmp_path):
+@pytest.mark.parametrize("moe_experts", [0, 2])
+def test_generate_artifact_roundtrip(tmp_path, moe_experts):
+    """moe_experts=2: the MoE LM serves through the same AOT path (the
+    dense every-expert decode — router + top-2 combine — inside the
+    artifact)."""
     import jax.numpy as jnp
 
-    lm = models.TransformerLM(vocab=64, dim=32, depth=1, heads=4, max_seq=32)
+    lm = models.TransformerLM(
+        vocab=64, dim=32, depth=1, heads=4, max_seq=32,
+        moe_experts=moe_experts,
+    )
     params, _ = lm.init(jax.random.key(3))
     prompt = models.synthetic_tokens(2, 4, 64, seed=1)
 
@@ -61,22 +68,3 @@ def test_artifact_shape_is_static(tmp_path):
     with pytest.raises(Exception):
         fn(bad)
 
-
-def test_moe_generate_artifact_roundtrip(tmp_path):
-    """The MoE LM serves through the same AOT path: the dense
-    every-expert decode (router + top-2 combine inside the artifact)
-    exports to StableHLO and the served tokens equal live generate."""
-    import jax.numpy as jnp
-
-    lm = models.TransformerLM(
-        vocab=64, dim=32, depth=1, heads=4, max_seq=32, moe_experts=2,
-    )
-    params, _ = lm.init(jax.random.key(5))
-    prompt = models.synthetic_tokens(2, 4, 64, seed=2)
-
-    path = tmp_path / "moe_gen.stablehlo"
-    export.export_generate(lm, params, (2, 4), steps=5, path=path)
-    fn = export.load(path)
-    got = fn(prompt, jnp.uint32(0))
-    want = lm.generate(params, prompt, 5, key=jax.random.key(0))
-    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -1,0 +1,63 @@
+"""Quiet-host repro of bench.py's timed region — the regression-bisect
+harness used to resolve VERDICT r4 weak #1 (docs/perf.md "BENCH r4
+'regression' resolved as host noise").
+
+No tunnel probe, no torch baseline: CPU-pinned, 5 warmup + 60 timed
+steps, 3 repeats, best-of reported.  Point it at any checked-out tree:
+
+    python tools/bench_quick.py            # this tree
+    git worktree add /tmp/r3 <commit>
+    python tools/bench_quick.py /tmp/r3    # that tree
+
+Compare best-of numbers across trees on an OTHERWISE IDLE host (the
+container has one core; anything else running skews everything).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, sys.argv[1] if len(sys.argv) > 1 else ".")
+
+import numpy as np
+
+from tpu_dist.utils.platform import pin_cpu
+
+pin_cpu()
+import jax
+import jax.numpy as jnp
+
+from tpu_dist import comm, data, models, parallel, train
+from tpu_dist.utils.platform import host_sync
+
+BATCH, STEPS, WARMUP, REPEATS = 128, 60, 5, 3
+
+
+def main():
+    mesh = comm.make_mesh(1, ("data",), mesh_devices=jax.devices()[:1])
+    trainer = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh, train.TrainConfig()
+    )
+    ds = data.load_mnist("train", synthetic_size=BATCH * 4)
+    x = np.stack([ds[i][0] for i in range(BATCH)])
+    y = np.asarray([ds[i][1] for i in range(BATCH)], np.int32)
+    batch = parallel.shard_batch((jnp.asarray(x), jnp.asarray(y)), mesh)
+    key = jax.random.key(0)
+
+    p, ms, os_ = trainer.params, trainer.model_state, trainer.opt_state
+    for _ in range(WARMUP):
+        p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
+    host_sync(loss)
+    best = float("inf")
+    for r in range(REPEATS):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
+        host_sync(loss)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+        print(f"repeat {r}: {dt:.3f}s -> {STEPS * BATCH / dt:,.0f} samples/s")
+    print(f"BEST {STEPS * BATCH / best:,.0f} samples/s")
+
+
+if __name__ == "__main__":
+    main()
