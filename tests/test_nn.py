@@ -249,3 +249,23 @@ class TestAttentionMask:
         np.testing.assert_allclose(
             np.asarray(logits[:, :6]), np.asarray(la), atol=1e-5
         )
+
+
+def test_sequential_rejects_mismatched_trees():
+    """A bare {} (or truncated tree) must raise, not silently apply
+    zero layers and return the input unchanged (the zip-truncation
+    footgun found while writing the accum HLO test)."""
+    import pytest
+
+    from tpu_dist import models
+
+    model = models.mnist_net()
+    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+    x = jnp.zeros((2,) + models.IN_SHAPE, jnp.float32)
+    with pytest.raises(ValueError, match="param entries"):
+        model.apply(params, {}, x)
+    with pytest.raises(ValueError, match="param entries"):
+        model.apply((), state, x)
+    # the real trees still work
+    y, _ = model.apply(params, state, x)
+    assert y.shape == (2, 10)

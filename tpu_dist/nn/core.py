@@ -85,6 +85,15 @@ class Sequential(Module):
         return shape
 
     def apply(self, params, state, x, *, train=False, key=None):
+        # zip would SILENTLY truncate on a mismatched tree (e.g. a bare
+        # {} for state applies zero layers and returns x unchanged —
+        # a confusing identity forward instead of an error)
+        if len(params) != len(self.layers) or len(state) != len(self.layers):
+            raise ValueError(
+                f"Sequential.apply: {len(self.layers)} layers but "
+                f"{len(params)} param entries / {len(state)} state "
+                f"entries — pass the trees from init() unchanged"
+            )
         keys = (
             jax.random.split(key, max(len(self.layers), 1))
             if key is not None

@@ -22,6 +22,7 @@ from tpu_dist.parallel.ring_attention import (  # noqa: I001
 from tpu_dist.parallel.moe import (
     EXPERT_AXIS,
     moe_mlp,
+    moe_mlp_expert_choice,
     moe_mlp_top2,
     stack_expert_params,
 )
@@ -88,6 +89,7 @@ __all__ = [
     "allgather_matmul",
     "matmul_reduce_scatter",
     "moe_mlp",
+    "moe_mlp_expert_choice",
     "moe_mlp_top2",
     "pipeline_apply",
     "pipeline_apply_interleaved",

@@ -167,6 +167,95 @@ def moe_mlp_top2(
     return y, stats
 
 
+def moe_mlp_expert_choice(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    axis_name: str = EXPERT_AXIS,
+    capacity_factor: float = 2.0,
+    activation=jax.nn.gelu,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Expert-choice MoE MLP (Zhou et al. 2022) inside shard_map: the
+    EXPERTS pick their tokens, not the other way around.
+
+    Each expert takes its top-``C`` tokens over the GLOBAL batch by
+    router score (``C = T_local · capacity_factor``), so every expert is
+    perfectly load-balanced by construction — no balance-loss auxiliary,
+    no capacity overflow drops (a token is "dropped" only if no expert
+    chose it, which top-scoring tokens never are; it then contributes
+    zero, so use the layer residually like the others).
+
+    Wire pattern (all static shapes): scores all_gather (tiny, T×n),
+    identical global top-C on every rank; one ``all_to_all`` ships each
+    rank's owned slots of every expert's token list to the expert (rows
+    summed on arrival — non-owned slots are zero); the expert MLP runs
+    on its (C, d) pick; one ``all_gather`` returns every expert's
+    outputs and each rank combines its own tokens weighted by the
+    router's softmax-over-experts gate.
+
+    Args/returns mirror `moe_mlp` (stats: total picks owned by this
+    rank, mean experts-per-token coverage over this rank's tokens).
+    """
+    n = lax.axis_size(axis_name)
+    r = lax.axis_index(axis_name)
+    T, d = x.shape
+    # the pick pool is the n·T global tokens — clamp so a generous
+    # capacity_factor (or a 1-rank axis) cannot ask top_k for more
+    # entries than exist
+    cap = max(1, min(int(T * capacity_factor), n * T))
+
+    scores = x @ gate_w  # (T, n) local
+    probs = jax.nn.softmax(scores, axis=-1)  # gates: softmax over experts
+    # identical global score table on every rank (tiny: T_global × n)
+    probs_g = lax.all_gather(probs, axis_name, axis=0, tiled=True)
+    Tg = n * T
+
+    # expert e's picks: top-cap GLOBAL token ids by its column, computed
+    # identically everywhere (deterministic)
+    top_w, top_idx = lax.top_k(probs_g.T, cap)  # (n, cap) each
+
+    # dispatch: this rank owns global tokens [r·T, (r+1)·T); fill the
+    # slots whose chosen token lives here, zero elsewhere
+    owner = top_idx // T  # (n, cap) source rank of each pick
+    local_tok = jnp.clip(top_idx - r * T, 0, T - 1)
+    mine = owner == r
+    dispatch = jnp.where(mine[:, :, None], x[local_tok], 0.0)  # (n, cap, d)
+    arriving = all_to_all(dispatch, axis_name, split_axis=0, concat_axis=0)
+    # (n, cap, d): source ranks' partial rows of MY expert — sum fills
+    # every slot exactly once (each slot owned by one rank)
+    picked = arriving.reshape(n, cap, d).sum(axis=0)  # (cap, d)
+
+    hidden = activation(picked @ w_up)
+    out_local = hidden @ w_down  # (cap, d) — my expert's outputs
+    # every expert's outputs everywhere (n · cap · d, same order as
+    # top_idx rows)
+    out_all = lax.all_gather(out_local, axis_name, axis=0)  # (n, cap, d)
+
+    # combine: token t's output = Σ over (e, slot) picks of t:
+    #   gate[t, e] · out_all[e, slot]
+    flat_idx = top_idx.reshape(-1)  # (n·cap,) global token ids
+    flat_out = out_all.reshape(n * cap, d)
+    flat_gate = top_w.reshape(-1)  # == probs_g[token, expert] of the pick
+    # scatter-add into the GLOBAL token axis, then slice my window —
+    # cheaper: mask to my window and scatter into (T, d)
+    in_mine = (flat_idx >= r * T) & (flat_idx < (r + 1) * T)
+    local_ids = jnp.clip(flat_idx - r * T, 0, T - 1)
+    y = jnp.zeros((T, d), x.dtype).at[local_ids].add(
+        jnp.where(in_mine[:, None], flat_gate[:, None] * flat_out, 0.0)
+    )
+    # coverage: how many experts picked each of MY tokens (mean)
+    cover = jnp.zeros((T,), jnp.float32).at[local_ids].add(
+        jnp.where(in_mine, 1.0, 0.0)
+    )
+    stats = {
+        "local_pick_count": jnp.sum(mine),
+        "mean_experts_per_token": cover.mean(),
+    }
+    return y, stats
+
+
 def stack_expert_params(experts: list[dict[str, Any]]) -> dict[str, Any]:
     """Stack per-expert param dicts on a leading axis (shard with
     ``P('expert')`` entering shard_map)."""
