@@ -141,3 +141,23 @@ def test_tensor_parallel_bf16_matches_dense_bf16(mesh, windows):
         assert tp_hist[0].mean_loss == pytest.approx(
             dense_hist[0].mean_loss, rel=2e-2
         ), layout
+
+
+@pytest.fixture(scope="module")
+def clipped_replicated_hist(mesh, windows):
+    return _trainer(mesh, grad_clip=0.05).fit(windows, epochs=2)
+
+
+@pytest.mark.parametrize("sharded", ["fsdp", "zero1"])
+def test_grad_clip_config_matches_replicated(
+    mesh, windows, sharded, clipped_replicated_hist
+):
+    """LMTrainConfig(grad_clip=): because clip_by_global_norm's
+    shard_update psums shard norms, the fsdp/zero1 trajectories equal
+    the replicated one (a tiny max_norm keeps clipping active every
+    step)."""
+    hist = _trainer(mesh, grad_clip=0.05, **{sharded: True}).fit(
+        windows, epochs=2
+    )
+    for a, b in zip(hist, clipped_replicated_hist, strict=True):
+        assert a.mean_loss == pytest.approx(b.mean_loss, rel=2e-4)

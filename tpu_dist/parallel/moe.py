@@ -187,6 +187,13 @@ def moe_mlp_expert_choice(
     chose it, which top-scoring tokens never are; it then contributes
     zero, so use the layer residually like the others).
 
+    CAUSALITY CAVEAT: the top-C competition conditions every token's
+    routing on the WHOLE batch — including future positions — so this
+    layer is for encoder / non-autoregressive models (the paper's
+    setting).  A causal LM trained with it would leak future
+    information through the routing decisions; that is why
+    `TransformerLM(moe_experts=)` uses token-choice top-2, not this.
+
     Wire pattern (all static shapes): scores all_gather (tiny, T×n),
     identical global top-C on every rank; one ``all_to_all`` ships each
     rank's owned slots of every expert's token list to the expert (rows

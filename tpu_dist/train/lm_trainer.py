@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from tpu_dist import parallel
 from tpu_dist.models.transformer_lm import lm_loss, lm_perplexity
-from tpu_dist.train.optim import Optimizer, adamw
+from tpu_dist.train.optim import Optimizer, adamw, clip_by_global_norm
 
 
 @dataclass
@@ -88,6 +88,11 @@ class LMTrainConfig:
     # with fsdp/zero1/accum_steps; mutually exclusive with the other
     # model-sharding modes.
     moe: bool = False
+    # Global-norm gradient clipping (LM-training staple).  Wraps the
+    # optimizer in `train.clip_by_global_norm`, whose shard_update psums
+    # squared shard norms — so clipping is by the TRUE global norm under
+    # fsdp/zero1 too, and every mode's trajectory still matches dense.
+    grad_clip: float | None = None
     log: Callable[[str], None] = print
 
 
@@ -117,6 +122,10 @@ class LMTrainer:
         self.config = config or LMTrainConfig()
         self.world = int(np.prod(mesh.devices.shape))
         self.optimizer = optimizer or adamw(self.config.lr)
+        if self.config.grad_clip is not None:
+            self.optimizer = clip_by_global_norm(
+                self.optimizer, self.config.grad_clip
+            )
 
         self._sharded_mode = self.config.fsdp or self.config.zero1
         if self.config.fsdp and self.config.zero1:
