@@ -160,6 +160,59 @@ class TestFlashAttention:
             ops.flash_attention(q, k, k, interpret=True)
 
 
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("window", [16, 64])
+    def test_sliding_window_matches_dense_band_mask(self, causal, window):
+        """window=w must equal dense attention under the band mask
+        k > q - w (optionally intersected with causal) — values AND all
+        three grads, through the windowed forward + backward kernels."""
+        from tpu_dist.nn import dot_product_attention
+
+        ks = jax.random.split(jax.random.key(11), 3)
+        shape = (1, 2, 128, 8)
+        q, k, v = (jax.random.normal(kk, shape) for kk in ks)
+        S = shape[-2]
+        pos = jnp.arange(S)
+        band = pos[None, :] > pos[:, None] - window  # k > q - w
+        if causal:
+            band = band & (pos[:, None] >= pos[None, :])
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                ops.flash_attention(
+                    q, k, v, causal=causal, window=window,
+                    bq=32, bk=32, interpret=True,
+                )
+                ** 2
+            )
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                dot_product_attention(q, k, v, mask=band) ** 2
+            )
+
+        np.testing.assert_allclose(
+            np.asarray(
+                ops.flash_attention(
+                    q, k, v, causal=causal, window=window,
+                    bq=32, bk=32, interpret=True,
+                )
+            ),
+            np.asarray(dot_product_attention(q, k, v, mask=band)),
+            rtol=2e-5, atol=2e-5,
+        )
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+    def test_sliding_window_validates(self):
+        q = jnp.ones((1, 1, 128, 8))
+        with pytest.raises(ValueError, match="window"):
+            ops.flash_attention(q, q, q, window=0, interpret=True)
+
     def test_gqa_through_module_grads_match_dense(self, monkeypatch):
         """VERDICT r4 #5: the Pallas backward kernels must hold for the
         GQA composition too — `nn.MultiHeadAttention(kv_heads < heads)`

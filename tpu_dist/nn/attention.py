@@ -24,6 +24,7 @@ def dot_product_attention(
     *,
     causal: bool = False,
     mask: jax.Array | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Softmax attention. Shapes: (..., heads, seq, head_dim).
 
@@ -40,12 +41,20 @@ def dot_product_attention(
     decode-style convention flash-attention implementations use.  For any
     other cross-attention alignment, build the mask yourself.
 
+    ``window=w`` restricts attention to the sliding band ``k > q - w``
+    (Mistral-style local attention; combine with ``causal`` for the
+    autoregressive band).  The flash kernel handles it NATIVELY —
+    blocks outside the band are skipped, O(S·w) work — while the dense
+    path materializes the band mask.
+
     With ``TPU_DIST_FLASH=1`` the blockwise Pallas kernel
     (`tpu_dist.ops.flash_attention`) takes over for sequences past its
     block size — no (S, S) materialization; numerics match to fp
     tolerance (differentiable either way)."""
     import os
 
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     if os.environ.get("TPU_DIST_FLASH", "0") == "1":
         S = q.shape[-2]
         bq = bk = min(256, S)
@@ -60,7 +69,8 @@ def dot_product_attention(
 
             interp = jax.default_backend() != "tpu"
             return flash_attention(
-                q, k, v, causal=causal, bq=bq, bk=bk, interpret=interp
+                q, k, v, causal=causal, bq=bq, bk=bk, interpret=interp,
+                window=window,
             )
         # fall through to the dense path for shapes the kernel can't take
         # (cross-attention, indivisible block sizes, short sequences)
@@ -70,6 +80,12 @@ def dot_product_attention(
     visible = None
     if causal:
         visible = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+    if window is not None:
+        # band over ABSOLUTE key positions; queries are the last sq of
+        # the sk-long sequence (same alignment convention as causal)
+        q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+        band = jnp.arange(sk)[None, :] > q_pos - window
+        visible = band if visible is None else (visible & band)
     if mask is not None:
         m = jnp.broadcast_to(mask, logits.shape)
         visible = m if visible is None else (visible & m)
@@ -113,7 +129,9 @@ class MultiHeadAttention(Module):
 
     ``kv_heads`` enables grouped-query attention (GQA): fewer key/value
     heads than query heads, each shared by ``heads // kv_heads`` query
-    heads.  The KV cache shrinks by the same factor — the reason GQA is
+    heads.  ``sliding_window=w`` restricts attention to the local band
+    ``k > q - w`` in BOTH the parallel forward (flash kernel skips
+    out-of-band blocks under TPU_DIST_FLASH=1) and cached decode.  The KV cache shrinks by the same factor — the reason GQA is
     the modern long-context inference layout (``kv_heads=1`` is
     multi-query attention).  With ``kv_heads == heads`` (default) the
     layer is exactly the classic fused-QKV MHA, param structure and all.
@@ -127,6 +145,7 @@ class MultiHeadAttention(Module):
         causal: bool = False,
         kv_heads: int | None = None,
         use_rope: bool = False,
+        sliding_window: int | None = None,
     ):
         if dim % heads:
             raise ValueError(f"dim {dim} not divisible by heads {heads}")
@@ -144,6 +163,11 @@ class MultiHeadAttention(Module):
             raise ValueError(
                 f"heads {heads} not divisible by kv_heads {self.kv_heads}"
             )
+        if sliding_window is not None and sliding_window < 1:
+            raise ValueError(
+                f"sliding_window must be >= 1, got {sliding_window}"
+            )
+        self.sliding_window = sliding_window
         self.group = heads // self.kv_heads
         if self.group == 1:
             self._qkv = Dense(3 * dim)
@@ -197,7 +221,7 @@ class MultiHeadAttention(Module):
             mask = mask[:, None, None, :]  # keys masked, all queries
         o = dot_product_attention(
             q, self._expand_kv(k), self._expand_kv(v),
-            causal=self.causal, mask=mask,
+            causal=self.causal, mask=mask, window=self.sliding_window,
         )
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, self.dim)
         y, _ = self._out.apply(params["out"], {}, o)
@@ -245,7 +269,12 @@ class MultiHeadAttention(Module):
         )
         pos = jnp.arange(cache_len)[None, :]
         qpos = index + jnp.arange(s)[:, None]
-        logits = jnp.where(pos <= qpos, logits, -1e30)
+        visible = pos <= qpos
+        if self.sliding_window is not None:
+            # same band as the parallel forward: k > q - window, so
+            # windowed decode matches windowed training exactly
+            visible = visible & (pos > qpos - self.sliding_window)
+        logits = jnp.where(visible, logits, -1e30)
         weights = jax.nn.softmax(logits, axis=-1)
         o = jnp.einsum(
             "bhqk,bhkd->bhqd",

@@ -31,7 +31,21 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int, causal: bool):
+def _band_mask(i, j, bq, bk, causal, window):
+    """The visibility mask for (query block i, key block j): causal
+    lower-triangle, optionally intersected with the sliding-window band
+    ``k > q - window`` (the Mistral-style local-attention pattern)."""
+    q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    mask = q_pos >= k_pos if causal else None
+    if window is not None:
+        band = k_pos > q_pos - window
+        mask = band if mask is None else (mask & band)
+    return mask
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int,
+                  causal: bool, window: int | None):
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
     bq, d = q.shape
     S = k_ref.shape[1]
@@ -39,21 +53,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int, causal: bool)
     qs = q * scale
     i = pl.program_id(1)
     nblocks = S // bk
+    masked = causal or window is not None
 
     def body(j, carry):
         m, l, acc = carry
         k_blk = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         logits = jnp.dot(qs, k_blk.T, preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            logits = jnp.where(q_pos >= k_pos, logits, NEG_INF)
+        if masked:
+            mask = _band_mask(i, j, bq, bk, causal, window)
+            logits = jnp.where(mask, logits, NEG_INF)
         m_new = jnp.maximum(m, logits.max(-1))
         correction = jnp.exp(m - m_new)
         p = jnp.exp(logits - m_new[:, None])
-        if causal:
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        if masked:
+            p = jnp.where(mask, p, 0.0)
         l_new = l * correction + p.sum(-1)
         acc_new = acc * correction[:, None] + jnp.dot(
             p, v_blk, preferred_element_type=jnp.float32
@@ -69,7 +83,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int, causal: bool)
         hi = lax.min(nblocks, ((i + 1) * bq + bk - 1) // bk)
     else:
         hi = nblocks
-    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    if window is not None:
+        # ...and key blocks wholly BEFORE the window: the earliest key
+        # this query block can see is i*bq - window + 1, so work is
+        # O(S·window) instead of O(S²) — the sliding-window payoff.
+        lo = lax.max(0, (i * bq - window + 1) // bk)
+    else:
+        lo = 0
+    m, l, acc = lax.fori_loop(lo, hi, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
     # log-sum-exp per query row (saved for the backward pass).  lse is
     # carried as (bh, S, 1) — the trailing singleton makes every block
@@ -79,9 +100,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bk: int, causal: bool)
     lse_ref[0] = (m + jnp.log(l))[:, None]
 
 
-def _flash_forward(q3, k3, v3, causal, bq, bk, interpret):
+def _flash_forward(q3, k3, v3, causal, bq, bk, interpret, window=None):
     bh, S, d = q3.shape
-    kernel = functools.partial(_flash_kernel, bk=bk, causal=causal)
+    kernel = functools.partial(
+        _flash_kernel, bk=bk, causal=causal, window=window
+    )
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, S // bq),
@@ -117,6 +140,7 @@ def flash_attention_lse(
     bq: int = 256,
     bk: int = 256,
     interpret: bool = False,
+    window: int | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """`flash_attention` that ALSO returns the per-row log-sum-exp
     ``(..., S)`` the kernel already computes for its backward pass.
@@ -127,6 +151,8 @@ def flash_attention_lse(
     ring-attention composition (`parallel.ring_attention_flash`).
     Forward-only (no VJP); compositions define their own backward.
     """
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     *lead, S, d = q.shape
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
@@ -139,24 +165,24 @@ def flash_attention_lse(
         bh *= x
     out, lse = _flash_forward(
         q.reshape(bh, S, d), k.reshape(bh, S, d), v.reshape(bh, S, d),
-        causal, bq, bk, interpret,
+        causal, bq, bk, interpret, window,
     )
     return out.reshape(q.shape), lse[..., 0].reshape(*lead, S)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q3, k3, v3, causal, bq, bk, interpret):
-    out, _ = _flash_forward(q3, k3, v3, causal, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q3, k3, v3, causal, bq, bk, interpret, window):
+    out, _ = _flash_forward(q3, k3, v3, causal, bq, bk, interpret, window)
     return out
 
 
-def _flash_fwd(q3, k3, v3, causal, bq, bk, interpret):
-    out, lse = _flash_forward(q3, k3, v3, causal, bq, bk, interpret)
+def _flash_fwd(q3, k3, v3, causal, bq, bk, interpret, window):
+    out, lse = _flash_forward(q3, k3, v3, causal, bq, bk, interpret, window)
     return out, (q3, k3, v3, out, lse)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
-                *, bq: int, causal: bool):
+                *, bq: int, causal: bool, window: int | None):
     """Backward kernel A: one program per (batch·head, KEY block);
     scans query blocks accumulating dK, dV for this key block in f32."""
     ks = k_ref[0].astype(jnp.float32)  # (bk, d)
@@ -166,6 +192,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
     scale = d**-0.5
     j = pl.program_id(1)
     nq = S // bq
+    masked = causal or window is not None
 
     def body(qi, carry):
         dk, dv = carry
@@ -174,13 +201,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
         lse = lse_ref[0, pl.ds(qi * bq, bq), 0]
         dd = d_ref[0, pl.ds(qi * bq, bq), 0]
         logits = jnp.dot(q * scale, ks.T, preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk_), 0)
-            k_pos = j * bk_ + lax.broadcasted_iota(jnp.int32, (bq, bk_), 1)
-            mask = q_pos >= k_pos
+        if masked:
+            mask = _band_mask(qi, j, bq, bk_, causal, window)
             logits = jnp.where(mask, logits, NEG_INF)
         p = jnp.exp(logits - lse[:, None])  # (bq, bk)
-        if causal:
+        if masked:
             p = jnp.where(mask, p, 0.0)
         dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, vs.T, preferred_element_type=jnp.float32)
@@ -193,15 +218,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dk_ref, dv_ref,
         lo = (j * bk_) // bq
     else:
         lo = 0
+    if window is not None:
+        # the LAST query that can see key block j is (j+1)*bk-1+window-1
+        hi = lax.min(nq, ((j + 1) * bk_ - 1 + window - 1) // bq + 1)
+    else:
+        hi = nq
     dk0 = jnp.zeros((bk_, d), jnp.float32)
     dv0 = jnp.zeros((bk_, d), jnp.float32)
-    dk, dv = lax.fori_loop(lo, nq, body, (dk0, dv0))
+    dk, dv = lax.fori_loop(lo, hi, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
-               *, bk: int, causal: bool):
+               *, bk: int, causal: bool, window: int | None):
     """Backward kernel B: one program per (batch·head, QUERY block);
     scans key blocks accumulating dQ in f32."""
     q = q_ref[0].astype(jnp.float32)  # (bq, d)
@@ -213,29 +243,33 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, d_ref, dq_ref,
     scale = d**-0.5
     i = pl.program_id(1)
     nk = S // bk
+    masked = causal or window is not None
 
     def body(j, dq):
         ks = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         vs = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         logits = jnp.dot(q * scale, ks.T, preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = i * bq_ + lax.broadcasted_iota(jnp.int32, (bq_, bk), 0)
-            k_pos = j * bk + lax.broadcasted_iota(jnp.int32, (bq_, bk), 1)
-            mask = q_pos >= k_pos
+        if masked:
+            mask = _band_mask(i, j, bq_, bk, causal, window)
             logits = jnp.where(mask, logits, NEG_INF)
         p = jnp.exp(logits - lse[:, None])
-        if causal:
+        if masked:
             p = jnp.where(mask, p, 0.0)
         dp = jnp.dot(do, vs.T, preferred_element_type=jnp.float32)
         ds = p * (dp - dd[:, None])
         return dq + jnp.dot(ds, ks, preferred_element_type=jnp.float32) * scale
 
     hi = lax.min(nk, ((i + 1) * bq_ + bk - 1) // bk) if causal else nk
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq_, d), jnp.float32))
+    lo = (
+        lax.max(0, (i * bq_ - window + 1) // bk)
+        if window is not None
+        else 0
+    )
+    dq = lax.fori_loop(lo, hi, body, jnp.zeros((bq_, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_bwd(causal, bq, bk, interpret, res, g):
+def _flash_bwd(causal, bq, bk, interpret, window, res, g):
     """Backward via two Pallas kernels (dK/dV by key block, dQ by query
     block) — the (S, S) score matrix is never formed on either pass.
     Standard flash recurrence: with P = exp(logits - lse) and
@@ -256,7 +290,7 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
         else pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
     )
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, bq=bq, causal=causal),
+        functools.partial(_dkv_kernel, bq=bq, causal=causal, window=window),
         grid=(bh, S // bk),
         in_specs=[full, pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
                   pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
@@ -273,7 +307,7 @@ def _flash_bwd(causal, bq, bk, interpret, res, g):
         interpret=interpret,
     )(q3, k3, v3, go, lse, D)
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, bk=bk, causal=causal),
+        functools.partial(_dq_kernel, bk=bk, causal=causal, window=window),
         grid=(bh, S // bq),
         in_specs=[pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
                   full, full,
@@ -292,7 +326,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "bq", "bk", "interpret")
+    jax.jit, static_argnames=("causal", "bq", "bk", "interpret", "window")
 )
 def flash_attention(
     q: jax.Array,
@@ -303,6 +337,7 @@ def flash_attention(
     bq: int = 256,
     bk: int = 256,
     interpret: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Attention over (..., heads, S, d) without materializing (S, S).
 
@@ -310,6 +345,15 @@ def flash_attention(
     divisible by the (clamped) block sizes.  Differentiable: the custom
     VJP runs the standard flash backward blockwise (peak intermediate
     (S, bk)), using the LSE saved by the forward kernel.
+
+    ``window=w`` adds the LOWER band bound ``k > q - w``; with
+    ``causal=True`` that is the sliding-window (Mistral-style)
+    autoregressive band ``(q - w, q]``, and forward + both backward
+    kernels skip out-of-band blocks — O(S·w) work instead of O(S²).
+    Without ``causal`` the bound is one-sided (queries still see all
+    FUTURE keys, and the past-side skip is the only saving); for
+    symmetric bidirectional local attention use the dense path with
+    `nn.sliding_window_mask`.
     """
     *lead, S, d = q.shape
     if q.shape != k.shape or q.shape != v.shape:
@@ -324,5 +368,7 @@ def flash_attention(
     q3 = q.reshape(bh, S, d)
     k3 = k.reshape(bh, S, d)
     v3 = v.reshape(bh, S, d)
-    out = _flash(q3, k3, v3, causal, bq, bk, interpret)
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    out = _flash(q3, k3, v3, causal, bq, bk, interpret, window)
     return out.reshape(q.shape)
