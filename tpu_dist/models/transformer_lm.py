@@ -75,6 +75,7 @@ class TransformerLM(Module):
         moe_experts: int = 0,
         moe_capacity_factor: float = 2.0,
         moe_balance_weight: float = 0.01,
+        sliding_window: int | None = None,
     ):
         if pos_embedding not in ("learned", "rope"):
             raise ValueError(
@@ -92,6 +93,11 @@ class TransformerLM(Module):
         self.moe_experts = moe_experts
         self.moe_capacity_factor = moe_capacity_factor
         self.moe_balance_weight = moe_balance_weight
+        # sliding_window=w: every block attends only the local band
+        # (q-w, q] — Mistral-style long-context attention; flows through
+        # dense forward, cached decode/generate, and (with
+        # TPU_DIST_FLASH=1) the windowed flash kernels.
+        self.sliding_window = sliding_window
         # Rematerialize each block's forward during backward
         # (jax.checkpoint): activation HBM drops from O(depth · B·S·d)
         # to O(B·S·d) + one extra forward of FLOPs — the standard TPU
@@ -108,6 +114,7 @@ class TransformerLM(Module):
             EncoderBlock(
                 dim, heads, causal=True, kv_heads=kv_heads,
                 use_rope=pos_embedding == "rope",
+                sliding_window=sliding_window,
             )
             for _ in range(depth)
         ]
@@ -140,6 +147,18 @@ class TransformerLM(Module):
                 jax.random.normal(ks[1], (1, self.max_seq, self.dim)) * 0.02
             )
         return params, {}
+
+    def _require_no_window(self, method: str) -> None:
+        """The sharded strategy paths compute full causal attention and
+        do not (yet) carry the sliding-window band — raise loudly
+        instead of silently diverging from the windowed dense forward
+        (same precedent as the rope/kv_heads guards)."""
+        if self.sliding_window is not None:
+            raise ValueError(
+                f"{method} does not support sliding_window yet — the "
+                "sharded attention cores compute the full causal mask; "
+                "use the dense paths (apply/generate) for windowed models"
+            )
 
     def _moe_dense(self, pm, x):
         """Exact dense evaluation of the top-2 MoE over ``(..., d)``
@@ -395,6 +414,7 @@ class TransformerLM(Module):
         `tpu_dist.parallel.tp_encoder_block`); embeddings, LayerNorms and
         the tied vocab head stay replicated.  Same replicated params as
         `apply`; tests assert fp-tolerance agreement."""
+        self._require_no_window("apply_tensor_parallel")
         from tpu_dist.parallel.tensor_parallel import tp_encoder_block
 
         if self.pos_embedding != "learned":
@@ -420,6 +440,7 @@ class TransformerLM(Module):
         recovers the dense gradient exactly — i.e. treat the model axis
         like a data axis in the gradient average and the training step
         needs no other change."""
+        self._require_no_window("loss_tensor_parallel")
         from tpu_dist.parallel.tensor_parallel import (
             tp_encoder_block,
             tp_vocab_cross_entropy,
@@ -450,6 +471,7 @@ class TransformerLM(Module):
         over ``axis_name`` exactly like `apply_tensor_parallel`.  Returns
         this rank's LOCAL logits ``(b, s_local, vocab)``; gathering them
         over the axis reproduces the dense `apply` (tested)."""
+        self._require_no_window("apply_tensor_parallel_sp")
         from jax import lax
 
         from tpu_dist.parallel.overlap import tp_encoder_block_sp
@@ -485,6 +507,7 @@ class TransformerLM(Module):
         The ``pmean`` over ``axis_name`` equals the dense `lm_loss`
         (tested) — so the model axis folds into the gradient average like
         a data axis, same contract as `loss_tensor_parallel`."""
+        self._require_no_window("loss_tensor_parallel_sp")
         logits_local = self.apply_tensor_parallel_sp(
             params, tokens_local, axis_name
         )
@@ -497,6 +520,7 @@ class TransformerLM(Module):
         drops n-fold per chip (the serving reason to decode
         tensor-parallel).  GQA composes: the smaller kv-head set shards
         the same way (``kv_heads % n == 0`` required)."""
+        self._require_no_window("init_cache_tp")
         from jax import lax
 
         n = lax.axis_size(axis_name)
@@ -565,6 +589,7 @@ class TransformerLM(Module):
         token from the same key (sampling is deterministic given both).
         Multi-chip serving: n chips' HBM bandwidth reads one model —
         the decode-latency analog of the training-side sharding."""
+        self._require_no_window("generate_tensor_parallel")
         from jax import lax
 
         b, s_p = prompt.shape
@@ -803,6 +828,7 @@ class TransformerLM(Module):
 
         from tpu_dist.parallel.ring_attention import RingMultiHeadAttention
 
+        self._require_no_window("apply_seq_parallel")
         if self.kv_heads != self.heads:
             raise ValueError(
                 "apply_seq_parallel requires kv_heads == heads (the ring "
@@ -885,6 +911,7 @@ class TransformerLM(Module):
         ``r*s_p_local ..``.  Returns (b, steps) sampled tokens
         (replicated).
         """
+        self._require_no_window("generate_seq_parallel")
         from jax import lax
 
         if self.kv_heads != self.heads:

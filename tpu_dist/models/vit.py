@@ -40,10 +40,11 @@ class EncoderBlock(Module):
 
     def __init__(self, dim: int, heads: int, mlp_ratio: int = 4, *,
                  causal: bool = False, kv_heads: int | None = None,
-                 use_rope: bool = False):
+                 use_rope: bool = False, sliding_window: int | None = None):
         self.ln1 = nn.LayerNorm()
         self.attn = nn.MultiHeadAttention(
-            dim, heads, causal=causal, kv_heads=kv_heads, use_rope=use_rope
+            dim, heads, causal=causal, kv_heads=kv_heads, use_rope=use_rope,
+            sliding_window=sliding_window,
         )
         self.ln2 = nn.LayerNorm()
         self.mlp = MLP(dim, dim * mlp_ratio)
