@@ -289,8 +289,38 @@ class TestSlidingWindowLM:
         for call in [
             lambda: lm.loss_tensor_parallel(params, tokens, "model"),
             lambda: lm.loss_tensor_parallel_sp(params, tokens, "model"),
-            lambda: lm.apply_seq_parallel(params, tokens, "seq"),
+            lambda: lm.apply_seq_parallel(params, tokens, "seq", flash=True),
             lambda: lm.init_cache_tp(2, "model"),
         ]:
             with pytest.raises(ValueError, match="sliding_window"):
                 call()
+
+    @pytest.mark.parametrize("attention", ["ring", "ulysses"])
+    def test_windowed_seq_parallel_matches_dense(self, attention):
+        """The sliding-window band flows through BOTH sequence-parallel
+        cores (global-position band in the ring; full-sequence band
+        after the Ulysses reshard) — sharded logits == windowed dense."""
+        N = 4
+        lm = models.TransformerLM(
+            vocab=32, dim=16, depth=1, heads=4, max_seq=32,
+            sliding_window=5,
+        )
+        params, _ = lm.init(jax.random.key(5))
+        tokens = models.synthetic_tokens(2, 32, 32)
+        dense, _ = lm.apply(params, {}, tokens)
+        s_local = 32 // N
+
+        def fn(params, tokens):
+            r = comm.rank()
+            local = jax.lax.dynamic_slice_in_dim(
+                tokens, r * s_local, s_local, 1
+            )
+            return lm.apply_seq_parallel(
+                params, local, comm.DEFAULT_AXIS, attention=attention
+            )
+
+        out = np.asarray(run(fn, params, tokens, world=N))
+        gathered = np.concatenate([out[r] for r in range(N)], axis=1)
+        np.testing.assert_allclose(
+            gathered, np.asarray(dense), rtol=2e-4, atol=2e-4
+        )

@@ -828,7 +828,13 @@ class TransformerLM(Module):
 
         from tpu_dist.parallel.ring_attention import RingMultiHeadAttention
 
-        self._require_no_window("apply_seq_parallel")
+        if self.sliding_window is not None and flash and attention != "ulysses":
+            raise ValueError(
+                "apply_seq_parallel(flash=True) does not support "
+                "sliding_window — the per-block flash kernels have no "
+                "cross-shard band offset; use the blockwise ring or "
+                "ulysses cores"
+            )
         if self.kv_heads != self.heads:
             raise ValueError(
                 "apply_seq_parallel requires kv_heads == heads (the ring "
@@ -850,6 +856,7 @@ class TransformerLM(Module):
             self.dim, self.heads, axis_name=axis_name, causal=True,
             use_rope=self.pos_embedding == "rope",
             use_flash=flash, interpret=interpret, core=attention,
+            sliding_window=self.sliding_window,
         )
         for blk, pb in zip(self.blocks, params["blocks"]):
             x1, _ = blk.ln1.apply(pb["ln1"], {}, h)

@@ -52,6 +52,7 @@ def ring_attention(
     axis_name: str,
     *,
     causal: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Blockwise ring attention over sequence shards.
 
@@ -60,6 +61,10 @@ def ring_attention(
         ``(batch, heads, s_local, d)``), with the sequence axis sharded
         over mesh axis ``axis_name``; global sequence order is rank-major.
       causal: apply a causal mask over *global* positions.
+      window: sliding-window band ``k > q - window`` over *global*
+        positions (combine with ``causal`` for the Mistral-style local
+        band) — same semantics as `nn.dot_product_attention(window=)`,
+        so windowed models train sequence-parallel == dense.
 
     Returns the local output shard ``(..., s_local, d)`` in the input
     dtype.  Numerically matches `tpu_dist.nn.dot_product_attention` on the
@@ -89,12 +94,14 @@ def ring_attention(
         logits = jnp.einsum(
             "...qd,...kd->...qk", qs, k_blk, preferred_element_type=jnp.float32
         )
+        q_pos = r * s_local + local_pos  # global query positions
+        k_pos = kv_rank * s_local + local_pos
         if causal:
-            q_pos = r * s_local + local_pos  # global query positions
-            k_pos = kv_rank * s_local + local_pos
             mask = q_pos[:, None] >= k_pos[None, :]
         else:
             mask = jnp.ones((s_local, s_local), bool)
+        if window is not None:
+            mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
         return _block_update(m, l, acc, logits, v_blk, mask)
 
     # Local block first, then n-1 steps of (rotate, process): exactly
@@ -234,11 +241,20 @@ class RingMultiHeadAttention:
     def __init__(self, dim: int, heads: int, *, axis_name: str,
                  causal: bool = False, use_rope: bool = False,
                  use_flash: bool = False, interpret: bool = False,
-                 core: str = "ring"):
+                 core: str = "ring", sliding_window: int | None = None):
         from tpu_dist import nn  # local import: nn must not depend on parallel
 
         if core not in ("ring", "ulysses"):
             raise ValueError(f"core must be 'ring' or 'ulysses', got {core!r}")
+        if sliding_window is not None and use_flash and core != "ulysses":
+            # (the ulysses core never consults use_flash — its local
+            # attention is full-sequence, so the band applies exactly)
+            raise ValueError(
+                "sliding_window is not supported with use_flash yet — "
+                "the per-block flash kernels have no cross-shard band "
+                "offset; use the dense blockwise ring or ulysses cores"
+            )
+        self.sliding_window = sliding_window
         self.core = core
         self.axis_name = axis_name
         self.causal = causal
@@ -286,7 +302,8 @@ class RingMultiHeadAttention:
             from tpu_dist.parallel.ulysses import ulysses_attention
 
             o = ulysses_attention(
-                q, k, v, self.axis_name, causal=self.causal
+                q, k, v, self.axis_name, causal=self.causal,
+                window=self.sliding_window,
             )
         elif self.use_flash:
             o = ring_attention_flash(
@@ -294,7 +311,10 @@ class RingMultiHeadAttention:
                 interpret=self.interpret,
             )
         else:
-            o = ring_attention(q, k, v, self.axis_name, causal=self.causal)
+            o = ring_attention(
+                q, k, v, self.axis_name, causal=self.causal,
+                window=self.sliding_window,
+            )
         o = jnp.moveaxis(o, 1, 2).reshape(b, s_local, self.dim)
         y, _ = d._out.apply(params["out"], {}, o)
         return y, state

@@ -30,6 +30,7 @@ def ulysses_attention(
     axis_name: str,
     *,
     causal: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Attention over sequence shards via head-resharding.
 
@@ -49,8 +50,10 @@ def ulysses_attention(
     reshard = lambda t: all_to_all(  # noqa: E731
         t, axis_name, split_axis=1, concat_axis=2
     )
+    # after resharding every head shard holds the FULL sequence, so the
+    # window band applies exactly as in the dense path
     o = dot_product_attention(
-        reshard(q), reshard(k), reshard(v), causal=causal
+        reshard(q), reshard(k), reshard(v), causal=causal, window=window
     )
     # head-sharded -> seq-sharded: (b, h/n, S, d) -> (b, h, s_local, d)
     return all_to_all(o, axis_name, split_axis=2, concat_axis=1)
