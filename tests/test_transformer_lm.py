@@ -287,13 +287,55 @@ class TestSlidingWindowLM:
         params, _ = lm.init(jax.random.key(4))
         tokens = models.synthetic_tokens(2, 8, 32)
         for call in [
-            lambda: lm.loss_tensor_parallel(params, tokens, "model"),
-            lambda: lm.loss_tensor_parallel_sp(params, tokens, "model"),
             lambda: lm.apply_seq_parallel(params, tokens, "seq", flash=True),
             lambda: lm.init_cache_tp(2, "model"),
         ]:
             with pytest.raises(ValueError, match="sliding_window"):
                 call()
+
+    @pytest.mark.parametrize("layout", ["psum", "sp"])
+    def test_windowed_tensor_parallel_matches_dense(self, layout):
+        """The band flows through BOTH tensor-parallel layouts (the
+        sharded-heads attention and the collective-matmul SP attention
+        both run full-sequence attention, so the dense window applies
+        exactly): sharded windowed logits == dense windowed logits."""
+        N = 4
+        lm = models.TransformerLM(
+            vocab=32, dim=8 * N, depth=1, heads=N, max_seq=32,
+            sliding_window=5,
+        )
+        params, _ = lm.init(jax.random.key(6))
+        tokens = models.synthetic_tokens(2, 16, 32)
+        dense, _ = lm.apply(params, {}, tokens)
+
+        if layout == "psum":
+            def fn(params, tokens):
+                return lm.apply_tensor_parallel(
+                    params, tokens, comm.DEFAULT_AXIS
+                )
+
+            out = np.asarray(run(fn, params, tokens, world=N))
+            for r in range(N):
+                np.testing.assert_allclose(
+                    out[r], np.asarray(dense), rtol=2e-4, atol=2e-4
+                )
+        else:
+            s_local = 16 // N
+
+            def fn(params, tokens):
+                r = comm.rank()
+                local = jax.lax.dynamic_slice_in_dim(
+                    tokens, r * s_local, s_local, 1
+                )
+                return lm.apply_tensor_parallel_sp(
+                    params, local, comm.DEFAULT_AXIS
+                )
+
+            out = np.asarray(run(fn, params, tokens, world=N))
+            gathered = np.concatenate([out[r] for r in range(N)], axis=1)
+            np.testing.assert_allclose(
+                gathered, np.asarray(dense), rtol=2e-4, atol=2e-4
+            )
 
     @pytest.mark.parametrize("attention", ["ring", "ulysses"])
     def test_windowed_seq_parallel_matches_dense(self, attention):

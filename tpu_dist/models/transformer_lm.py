@@ -149,15 +149,17 @@ class TransformerLM(Module):
         return params, {}
 
     def _require_no_window(self, method: str) -> None:
-        """The sharded strategy paths compute full causal attention and
-        do not (yet) carry the sliding-window band — raise loudly
-        instead of silently diverging from the windowed dense forward
-        (same precedent as the rope/kv_heads guards)."""
+        """The sharded DECODE paths (TP/CP caches) do not carry the
+        sliding-window band yet — raise loudly instead of silently
+        decoding with the full causal mask (same precedent as the
+        rope/kv_heads guards).  Windowed TRAINING is fully supported:
+        dense, tensor-parallel (both layouts), and sequence-parallel
+        (ring/ulysses) all carry the band."""
         if self.sliding_window is not None:
             raise ValueError(
                 f"{method} does not support sliding_window yet — the "
-                "sharded attention cores compute the full causal mask; "
-                "use the dense paths (apply/generate) for windowed models"
+                "sharded KV-cache decode paths compute the full causal "
+                "mask; decode windowed models with the dense generate()"
             )
 
     def _moe_dense(self, pm, x):
@@ -414,7 +416,6 @@ class TransformerLM(Module):
         `tpu_dist.parallel.tp_encoder_block`); embeddings, LayerNorms and
         the tied vocab head stay replicated.  Same replicated params as
         `apply`; tests assert fp-tolerance agreement."""
-        self._require_no_window("apply_tensor_parallel")
         from tpu_dist.parallel.tensor_parallel import tp_encoder_block
 
         if self.pos_embedding != "learned":
@@ -440,7 +441,6 @@ class TransformerLM(Module):
         recovers the dense gradient exactly — i.e. treat the model axis
         like a data axis in the gradient average and the training step
         needs no other change."""
-        self._require_no_window("loss_tensor_parallel")
         from tpu_dist.parallel.tensor_parallel import (
             tp_encoder_block,
             tp_vocab_cross_entropy,
@@ -471,7 +471,6 @@ class TransformerLM(Module):
         over ``axis_name`` exactly like `apply_tensor_parallel`.  Returns
         this rank's LOCAL logits ``(b, s_local, vocab)``; gathering them
         over the axis reproduces the dense `apply` (tested)."""
-        self._require_no_window("apply_tensor_parallel_sp")
         from jax import lax
 
         from tpu_dist.parallel.overlap import tp_encoder_block_sp
@@ -507,7 +506,6 @@ class TransformerLM(Module):
         The ``pmean`` over ``axis_name`` equals the dense `lm_loss`
         (tested) — so the model axis folds into the gradient average like
         a data axis, same contract as `loss_tensor_parallel`."""
-        self._require_no_window("loss_tensor_parallel_sp")
         logits_local = self.apply_tensor_parallel_sp(
             params, tokens_local, axis_name
         )
@@ -828,19 +826,23 @@ class TransformerLM(Module):
 
         from tpu_dist.parallel.ring_attention import RingMultiHeadAttention
 
-        if self.sliding_window is not None and flash and attention != "ulysses":
-            raise ValueError(
-                "apply_seq_parallel(flash=True) does not support "
-                "sliding_window — the per-block flash kernels have no "
-                "cross-shard band offset; use the blockwise ring or "
-                "ulysses cores"
-            )
+        # flash+window is refused by RingMultiHeadAttention's own guard
         if self.kv_heads != self.heads:
             raise ValueError(
                 "apply_seq_parallel requires kv_heads == heads (the ring "
                 "attention core uses the fused-QKV layout)"
             )
         b, s_local = tokens_local.shape
+        # Same block math as `apply`, with the attention core swapped for
+        # the ring module (identical param structure by construction).
+        # Constructed BEFORE any axis query so its validation (e.g. the
+        # flash+window refusal) raises cleanly outside shard_map too.
+        ring_mha = RingMultiHeadAttention(
+            self.dim, self.heads, axis_name=axis_name, causal=True,
+            use_rope=self.pos_embedding == "rope",
+            use_flash=flash, interpret=interpret, core=attention,
+            sliding_window=self.sliding_window,
+        )
         n = lax.axis_size(axis_name)
         if n * s_local > self.max_seq:
             raise ValueError(
@@ -850,14 +852,6 @@ class TransformerLM(Module):
             )
         r = lax.axis_index(axis_name)
         h = self._trunk(params, tokens_local, pos_offset=r * s_local)
-        # Same block math as `apply`, with the attention core swapped for
-        # the ring module (identical param structure by construction).
-        ring_mha = RingMultiHeadAttention(
-            self.dim, self.heads, axis_name=axis_name, causal=True,
-            use_rope=self.pos_embedding == "rope",
-            use_flash=flash, interpret=interpret, core=attention,
-            sliding_window=self.sliding_window,
-        )
         for blk, pb in zip(self.blocks, params["blocks"]):
             x1, _ = blk.ln1.apply(pb["ln1"], {}, h)
             o, _ = ring_mha.apply(pb["attn"], {}, x1)

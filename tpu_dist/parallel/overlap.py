@@ -192,6 +192,7 @@ def tp_attention_overlapped(
     *,
     causal: bool = True,
     bidirectional: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Sharded-heads attention with SEQUENCE-SHARDED activations: the
     all-gather before the QKV projection and the reduce-scatter after the
@@ -245,7 +246,9 @@ def tp_attention_overlapped(
 
     from tpu_dist.nn.attention import dot_product_attention
 
-    o = dot_product_attention(q, k, v, causal=causal)  # (b, hl, S, hd)
+    # the gathered sequence is FULL here, so the window band applies
+    # exactly as in the dense path
+    o = dot_product_attention(q, k, v, causal=causal, window=window)  # (b, hl, S, hd)
     # back to rank-major rows for the reduce-scatter
     o_rows = (
         o.reshape(b, hl, n, s_l, hd)
@@ -281,6 +284,7 @@ def tp_encoder_block_sp(
     x = x_shard + tp_attention_overlapped(
         h, params["attn"], block.attn.heads, axis_name,
         causal=block.attn.causal, bidirectional=bidirectional,
+        window=getattr(block.attn, "sliding_window", None),
     )
     h, _ = block.ln2.apply(params["ln2"], {}, x)
     return x + tp_mlp_overlapped(

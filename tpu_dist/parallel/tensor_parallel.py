@@ -101,6 +101,7 @@ def tp_attention(
     axis_name: str = MODEL_AXIS,
     *,
     causal: bool = False,
+    window: int | None = None,
 ) -> jax.Array:
     """Megatron-style sharded-heads attention: each rank runs
     ``heads / axis_size`` complete heads locally and the row-parallel
@@ -161,7 +162,9 @@ def tp_attention(
         k = jnp.take(k_full, kv_idx, axis=1)
         v = jnp.take(v_full, kv_idx, axis=1)
 
-    o = dot_product_attention(q, k, v, causal=causal)  # (b, hl, s, hd)
+    # full-sequence attention on local heads: the sliding-window band
+    # applies exactly as in the dense path
+    o = dot_product_attention(q, k, v, causal=causal, window=window)  # (b, hl, s, hd)
     o = jnp.moveaxis(o, 1, 2).reshape(bsz, s, hl * hd)
 
     wo_loc = lax.dynamic_slice_in_dim(
@@ -366,6 +369,7 @@ def tp_encoder_block(block, params, x, axis_name: str = MODEL_AXIS):
     x = x + tp_attention(
         h, params["attn"], block.attn.heads, axis_name,
         causal=block.attn.causal,
+        window=getattr(block.attn, "sliding_window", None),
     )
     h, _ = block.ln2.apply(params["ln2"], {}, x)
     return x + tp_mlp_block(h, params["mlp"], axis_name)
