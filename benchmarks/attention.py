@@ -30,7 +30,14 @@ def main():
     ap.add_argument("--batch", type=int, default=1)
     ap.add_argument("--seqs", type=int, nargs="+", default=[1024, 4096, 8192])
     ap.add_argument("--causal", action="store_true")
+    ap.add_argument(
+        "--window", type=int, default=None,
+        help="sliding-window band width: adds flash_window and "
+             "ring_window rows (O(S·w) work — the local-attention win)",
+    )
     args = ap.parse_args()
+    if args.window is not None and args.window < 1:
+        raise SystemExit(f"--window must be >= 1, got {args.window}")
     if args.platform == "cpu":
         from tpu_dist.utils.platform import pin_cpu
 
@@ -64,6 +71,11 @@ def main():
                         a, b, c, ax, causal=causal, interpret=interp
                     )
                 ),
+                "ring_window": lambda a, b, c, ax, causal: (
+                    parallel.ring_attention(
+                        a, b, c, ax, causal=causal, window=args.window
+                    )
+                ),
             }[fn_name]
             mapped = jax.jit(
                 jax.shard_map(
@@ -76,13 +88,26 @@ def main():
             )
             return lambda y: mapped(y, y, y)
 
-        row = {}
-        for name, step in [
+        cases = [
             ("full", lambda y: dot_product_attention(y, y, y, causal=args.causal)),
             ("ring", sharded("ring")),
             ("ring_flash", sharded("ring_flash")),
             ("ulysses", sharded("ulysses")),
-        ]:
+        ]
+        if args.window is not None:
+            from tpu_dist.ops.flash_attention import flash_attention
+
+            interp = args.platform == "cpu"
+            w = args.window
+            cases.append((
+                "flash_window",
+                lambda y: flash_attention(
+                    y, y, y, causal=args.causal, window=w, interpret=interp
+                ),
+            ))
+            cases.append(("ring_window", sharded("ring_window")))
+        row = {}
+        for name, step in cases:
             try:
                 # self-attention is shape-preserving: chain out -> q
                 row[name] = bench_chain(step, q, iters=5) * 1e3
@@ -96,7 +121,8 @@ def main():
         )
         print(f"S={S:6d}  {cells}", file=sys.stderr)
     print(json.dumps({"metric": "attention_ms", "world": args.world,
-                      "causal": args.causal, "results": results}))
+                      "causal": args.causal, "window": args.window,
+                      "results": results}))
 
 
 if __name__ == "__main__":

@@ -124,3 +124,16 @@ def test_bench_forced_lm_path_survives_bad_args():
     assert out["metric"] == "mnist_dp_train_samples_per_sec_per_chip"
     assert "lm_mfu" not in out
     assert "inline LM MFU run failed" in proc.stderr
+
+
+def test_attention_bench_windowed_smoke():
+    out = run_bench(
+        "attention.py", "--platform", "cpu", "--world", "2",
+        "--seqs", "256", "--causal", "--window", "64",
+        "--heads", "2", "--dim", "16",
+    )
+    assert out["metric"] == "attention_ms"
+    assert out["window"] == 64
+    row = out["results"]["256"]
+    assert row["flash_window"] is not None
+    assert row["ring_window"] is not None
