@@ -288,10 +288,32 @@ class TestSlidingWindowLM:
         tokens = models.synthetic_tokens(2, 8, 32)
         for call in [
             lambda: lm.apply_seq_parallel(params, tokens, "seq", flash=True),
-            lambda: lm.init_cache_tp(2, "model"),
+            lambda: lm.generate_seq_parallel(params, tokens, 2, "seq"),
         ]:
             with pytest.raises(ValueError, match="sliding_window"):
                 call()
+
+    def test_windowed_tp_decode_matches_dense_generate(self):
+        """Windowed TENSOR-PARALLEL decode: the band lands in the
+        sharded-heads KV-cache attention, so TP generate == the windowed
+        dense generate token for token."""
+        N = 4
+        lm = models.TransformerLM(
+            vocab=32, dim=8 * N, depth=1, heads=N, max_seq=32,
+            sliding_window=5,
+        )
+        params, _ = lm.init(jax.random.key(7))
+        prompt = models.synthetic_tokens(1, 6, 32)
+        want = np.asarray(lm.generate(params, prompt, 5))
+
+        def fn(params, prompt):
+            return lm.generate_tensor_parallel(
+                params, prompt, 5, comm.DEFAULT_AXIS
+            )
+
+        out = np.asarray(run(fn, params, prompt, world=N))
+        for r in range(N):
+            np.testing.assert_array_equal(out[r], want)
 
     @pytest.mark.parametrize("layout", ["psum", "sp"])
     def test_windowed_tensor_parallel_matches_dense(self, layout):

@@ -149,17 +149,17 @@ class TransformerLM(Module):
         return params, {}
 
     def _require_no_window(self, method: str) -> None:
-        """The sharded DECODE paths (TP/CP caches) do not carry the
-        sliding-window band yet — raise loudly instead of silently
-        decoding with the full causal mask (same precedent as the
-        rope/kv_heads guards).  Windowed TRAINING is fully supported:
-        dense, tensor-parallel (both layouts), and sequence-parallel
-        (ring/ulysses) all carry the band."""
+        """Context-parallel decode does not carry the sliding-window
+        band yet (its prompt-phase ring + LSE merge assume the full
+        causal mask) — raise loudly instead of silently decoding wrong
+        (same precedent as the rope/kv_heads guards).  Windowed
+        elsewhere: dense + TP decode, and every training strategy
+        except the flash-block ring (which has its own guard)."""
         if self.sliding_window is not None:
             raise ValueError(
-                f"{method} does not support sliding_window yet — the "
-                "sharded KV-cache decode paths compute the full causal "
-                "mask; decode windowed models with the dense generate()"
+                f"{method} does not support sliding_window yet — "
+                "context-parallel decode computes the full causal mask; "
+                "use dense generate() or generate_tensor_parallel()"
             )
 
     def _moe_dense(self, pm, x):
@@ -518,7 +518,6 @@ class TransformerLM(Module):
         drops n-fold per chip (the serving reason to decode
         tensor-parallel).  GQA composes: the smaller kv-head set shards
         the same way (``kv_heads % n == 0`` required)."""
-        self._require_no_window("init_cache_tp")
         from jax import lax
 
         n = lax.axis_size(axis_name)
@@ -558,6 +557,7 @@ class TransformerLM(Module):
             o, ck, cv = tp_attention_cached(
                 x1, pb["attn"], blk.attn.heads, c["k"], c["v"], index,
                 axis_name, use_rope=self.pos_embedding == "rope",
+                window=self.sliding_window,
             )
             h = h + o
             x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
@@ -587,7 +587,6 @@ class TransformerLM(Module):
         token from the same key (sampling is deterministic given both).
         Multi-chip serving: n chips' HBM bandwidth reads one model —
         the decode-latency analog of the training-side sharding."""
-        self._require_no_window("generate_tensor_parallel")
         from jax import lax
 
         b, s_p = prompt.shape

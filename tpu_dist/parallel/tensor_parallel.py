@@ -183,6 +183,7 @@ def tp_attention_cached(
     axis_name: str = MODEL_AXIS,
     *,
     use_rope: bool = False,
+    window: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Sharded-heads incremental attention for tensor-parallel DECODE:
     each rank runs ``heads / n`` complete heads against its OWN slice of
@@ -271,7 +272,12 @@ def tp_attention_cached(
     )
     pos_k = jnp.arange(cache_len)[None, :]
     qpos = index + jnp.arange(s)[:, None]
-    logits = jnp.where(pos_k <= qpos, logits, -1e30)
+    visible = pos_k <= qpos
+    if window is not None:
+        # same band as the parallel forward (k > q - window): windowed
+        # decode matches windowed training exactly
+        visible = visible & (pos_k > qpos - window)
+    logits = jnp.where(visible, logits, -1e30)
     weights = jax.nn.softmax(logits, axis=-1)
     o = jnp.einsum("bhqk,bhkd->bhqd", weights, v_full.astype(q.dtype))
     o = jnp.moveaxis(o, 1, 2).reshape(b, s, hl * hd)
