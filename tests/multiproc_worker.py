@@ -268,7 +268,9 @@ def main():
     except RuntimeError as e:
         elapsed = time.perf_counter() - t0
         assert "injected failure in rank 1" in str(e), e
-        assert elapsed < 60, f"fail-stop took {elapsed:.0f}s (should be fast)"
+        # "fast" relative to the 120s launch timeout; generous because
+        # a loaded single-core host stretches process spawn+jax init
+        assert elapsed < 100, f"fail-stop took {elapsed:.0f}s (should be fast)"
     print(f"MULTIPROCESS FAILSTOP OK ({elapsed:.1f}s)")
 
 
