@@ -6,13 +6,19 @@ WORLD ?= 8
 PLATFORM ?= cpu
 DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 
-.PHONY: test ptp gather allreduce train bench runtime train-image \
+.PHONY: test chaos ptp gather allreduce train bench runtime train-image \
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
-        docs demos
+        chaos-resume docs demos
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+chaos:  # the fault-injection suite (kill/retry/resume; spawns real gangs)
+	$(PY) -m pytest tests/ -q -m chaos
+
+chaos-resume:
+	cd demos && $(PY) chaos_resume.py $(DEMOFLAGS)
 
 ptp:
 	cd demos && $(PY) ptp.py --world 2 --platform $(PLATFORM)

@@ -32,8 +32,10 @@ def main():
     ap.add_argument("--causal", action="store_true")
     ap.add_argument(
         "--window", type=int, default=None,
-        help="sliding-window band width: adds flash_window and "
-             "ring_window rows (O(S·w) work — the local-attention win)",
+        help="sliding-window band width: adds flash_window (O(S·w) work "
+             "— the local-attention win) and ring_window (window applied "
+             "as a mask; every K/V block still rotates, so O(S²/n) "
+             "compute+comm per rank) rows",
     )
     args = ap.parse_args()
     if args.window is not None and args.window < 1:

@@ -103,9 +103,21 @@ def sweep(args) -> dict:
     the result record (the caller prints/embeds it).  Platform pinning is
     the script entry's job — `bench.py` calls this in-process after its
     own probe so a flapping tunnel is not re-negotiated."""
+    # Set/restore, not set: in-process callers (bench.py's inline_lm_mfu)
+    # must not inherit the flash path for every later attention call.
+    prev_flash = os.environ.get("TPU_DIST_FLASH")
     if not args.no_flash:
         os.environ["TPU_DIST_FLASH"] = "1"
+    try:
+        return _sweep(args)
+    finally:
+        if prev_flash is None:
+            os.environ.pop("TPU_DIST_FLASH", None)
+        else:
+            os.environ["TPU_DIST_FLASH"] = prev_flash
 
+
+def _sweep(args) -> dict:
     import numpy as np
     import jax
     import jax.numpy as jnp

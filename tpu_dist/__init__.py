@@ -29,7 +29,22 @@ hand-rolled ring allreduce             ``parallel.ring_all_reduce`` (+ chunked)
 =====================================  ========================================
 """
 
-from tpu_dist import comm, data, export, models, nn, ops, parallel, train, utils
+from tpu_dist.utils import compat as _compat
+
+_compat.install()
+
+from tpu_dist import (  # noqa: E402
+    comm,
+    data,
+    export,
+    models,
+    nn,
+    ops,
+    parallel,
+    resilience,
+    train,
+    utils,
+)
 
 __version__ = "0.1.0"
 
@@ -41,6 +56,7 @@ __all__ = [
     "nn",
     "ops",
     "parallel",
+    "resilience",
     "train",
     "utils",
 ]

@@ -25,6 +25,9 @@ from dataclasses import dataclass
 
 import jax
 
+from tpu_dist.resilience import chaos as _chaos
+from tpu_dist.resilience.retry import RendezvousTimeout, RetryPolicy, retry_call
+
 
 @dataclass(frozen=True)
 class InitConfig:
@@ -177,10 +180,28 @@ def init(
             # Native TCP bootstrap (tpu_dist/runtime/rendezvous.cc):
             # startup barrier + rank assignment (process_id=None →
             # master-assigned, the MPI-style rank-less path of
-            # allreduce.py:54).
-            my_rank, _peers = runtime.rendezvous(
-                addr, port, cfg.num_processes, rank,
-                payload=os.uname().nodename,
+            # allreduce.py:54).  Retried under bounded exponential
+            # backoff (TPU_DIST_RDZV_* / TPU_DIST_STARTUP_DEADLINE
+            # knobs): a flaky coordinator or a slow-booting peer is the
+            # common case at pod scale, and every process runs the same
+            # schedule so the gang re-converges on a later attempt.  The
+            # chaos gate (`TPU_DIST_CHAOS=rdzv_fail=N`) injects failures
+            # through the identical path.
+            policy = RetryPolicy.from_env()
+
+            def _rendezvous(attempt):
+                _chaos.rendezvous_attempt(attempt)
+                return runtime.rendezvous(
+                    addr, port, cfg.num_processes, rank,
+                    payload=os.uname().nodename,
+                )
+
+            my_rank, _peers = retry_call(
+                _rendezvous,
+                policy=policy,
+                retry_on=(RuntimeError, OSError),
+                describe=f"rendezvous at {addr}:{port}",
+                error_type=RendezvousTimeout,
             )
             # Steady-state coordinator: one port above the rendezvous
             # port — both come from the same MASTER contract.
@@ -191,10 +212,16 @@ def init(
             process_id=my_rank,
             platform=cfg.platform,
         )
-        jax.distributed.initialize(
-            coordinator_address=coordinator,
-            num_processes=cfg.num_processes,
-            process_id=my_rank,
+        retry_call(
+            lambda _attempt: jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=cfg.num_processes,
+                process_id=my_rank,
+            ),
+            policy=RetryPolicy.from_env(),
+            retry_on=(RuntimeError,),
+            describe=f"jax.distributed.initialize via {coordinator}",
+            error_type=RendezvousTimeout,
         )
     _initialized = True
     return cfg

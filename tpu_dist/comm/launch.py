@@ -30,8 +30,16 @@ from typing import Any, Callable
 
 
 def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc,
-           init_method=None, assign_ranks=True):
+           init_method=None, assign_ranks=True, chaos_attempt=0):
     try:
+        # Chaos hooks first (import-light, pre-JAX): a `delay=` clause
+        # sleeps this rank, a `kill=` clause hard-exits it — the parent
+        # observes a child that died without reporting, which is exactly
+        # the failure mode the supervisor exists to detect.
+        from tpu_dist.resilience import chaos as _chaos
+
+        os.environ[_chaos.ATTEMPT_ENV_VAR] = str(chaos_attempt)
+        _chaos.at_launch(rank)
         if init_method:
             os.environ["TPU_DIST_INIT_METHOD"] = init_method
         else:
@@ -75,6 +83,7 @@ def launch(
     timeout: float = 300.0,
     init_method: str | None = None,
     assign_ranks: bool = True,
+    restarts: int = 0,
 ) -> list[Any]:
     """Fork-join ``world`` processes running ``fn(rank, world)``.
 
@@ -87,10 +96,60 @@ def launch(
     ``assign_ranks=False`` leaves RANK unset — every child does the
     MPI-style rank-less init and the rendezvous election assigns ranks
     (allreduce.py:54 analog).
+
+    ``restarts=N`` turns the fail-stop into a supervisor: when a child
+    dies (or fails) the whole gang is reaped and relaunched, up to N
+    times — a fork-join collective group has no single-rank recovery
+    (the survivors hold dead collective state), so the restart unit is
+    the gang.  Each attempt gets a fresh rendezvous port (when ``port``
+    is None) and exports its attempt index to the children
+    (`resilience.chaos.ATTEMPT_ENV_VAR`) so chaos kill clauses can be
+    scoped to one attempt.  Exhausted restarts raise
+    `resilience.WorkerFailed` with the last failure.
     """
+    from tpu_dist.resilience.retry import WorkerFailed, logger
+
+    last_error: Exception | None = None
+    for attempt in range(restarts + 1):
+        try:
+            return _launch_once(
+                fn, world, platform=platform, addr=addr, port=port,
+                devices_per_proc=devices_per_proc, timeout=timeout,
+                init_method=init_method, assign_ranks=assign_ranks,
+                attempt=attempt,
+            )
+        except WorkerFailed as e:
+            last_error = e
+            if attempt >= restarts:
+                break
+            logger.warning(
+                "launch attempt %d/%d failed (%s); relaunching the gang",
+                attempt + 1, restarts + 1, e,
+            )
+    assert last_error is not None
+    raise last_error
+
+
+def _launch_once(
+    fn: Callable[[int, int], Any],
+    world: int,
+    *,
+    platform: str | None,
+    addr: str,
+    port: int | None,
+    devices_per_proc: int,
+    timeout: float,
+    init_method: str | None,
+    assign_ranks: bool,
+    attempt: int = 0,
+) -> list[Any]:
+    """One supervised fork-join attempt (the pre-`restarts` launch body)."""
     from tpu_dist import runtime
+    from tpu_dist.resilience.retry import WorkerFailed
 
     if port is None:
+        # Fresh port per attempt: a relaunch must not race the dying
+        # gang's master socket (TIME_WAIT / stale registrations).
         port = runtime.free_port()
     ctx = mp.get_context("spawn")
     procs, conns = [], []
@@ -99,9 +158,14 @@ def launch(
         p = ctx.Process(
             target=_child,
             args=(fn, rank, world, addr, port, platform, child_conn,
-                  devices_per_proc, init_method, assign_ranks),
+                  devices_per_proc, init_method, assign_ranks, attempt),
         )
         p.start()
+        # Close the parent's copy of the child end NOW: with it open, a
+        # child that dies without reporting never EOFs its pipe and the
+        # supervisor would only notice at the full timeout — dead-child
+        # detection must be event-driven (pipe EOF), not timeout-driven.
+        child_conn.close()
         procs.append(p)
         conns.append(parent_conn)
     results: list[Any] = [None] * world
@@ -142,5 +206,7 @@ def launch(
         if p.is_alive():
             p.kill()
     if error is not None:
-        raise RuntimeError(f"launch failed — {error}")
+        # WorkerFailed subclasses RuntimeError, so pre-supervisor callers
+        # catching RuntimeError (and matching "launch failed") still work.
+        raise WorkerFailed(f"launch failed — {error}")
     return results

@@ -82,6 +82,14 @@ class TransformerLM(Module):
                 f"pos_embedding must be 'learned' or 'rope', got "
                 f"{pos_embedding!r}"
             )
+        if moe_experts < 0 or moe_experts == 1:
+            # top-2 routing needs at least two experts; a single-expert
+            # "mixture" would otherwise surface as an obscure trace-time
+            # top_k(k=2) crash deep in the MoE paths.
+            raise ValueError(
+                f"moe_experts must be 0 (dense MLP) or >= 2 (top-2 "
+                f"routing), got {moe_experts}"
+            )
         # moe_experts > 0 swaps every block's dense MLP for a top-2
         # (GShard-style) mixture of experts: per block a router
         # ``gate (d, E)`` plus expert-stacked ``up (E, d, 4d)`` /

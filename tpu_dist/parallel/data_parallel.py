@@ -23,6 +23,7 @@ Two styles are provided:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -232,24 +233,46 @@ def make_stateful_train_step(
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
-    def grads_and_metrics(params, model_state, batch, key):
-        """(grads, loss, new_state, aux) for one (micro)batch."""
-        (loss, (new_state, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True
-        )(params, model_state, batch, key)
-        return grads, loss, new_state, aux
+    # A `resilience.nan_guard`-wrapped optimizer advertises its live
+    # dynamic loss scale; the builder threads it through the backward
+    # pass (scaled loss in, unscaled grads + reported loss out) so the
+    # scale protects the bf16 intermediate gradients it exists for.
+    scale_fn = getattr(optimizer, "current_scale", None)
+    if scale_fn is not None:
+        # Import here, not module-top: guards pulls in tpu_dist.train,
+        # which circularly imports this package at tpu_dist-init time.
+        from tpu_dist.resilience.guards import _poison
 
-    def accumulate(params, model_state, batch, key):
-        return accumulate_microbatches(
-            grads_and_metrics, params, model_state, batch, key, accum_steps
-        )
+    def grads_and_metrics(params, model_state, batch, key, scale=None):
+        """(grads, loss, new_state, aux) for one (micro)batch; ``scale``
+        (a traced scalar) multiplies the loss before the backward and is
+        divided back out of grads and the reported loss."""
+        fn = loss_fn
+        if scale is not None:
+            def fn(p, s, b, k):
+                loss, (new_state, aux) = loss_fn(p, s, b, k)
+                return loss * scale, (new_state, aux)
+        (loss, (new_state, aux)), grads = jax.value_and_grad(
+            fn, has_aux=True
+        )(params, model_state, batch, key)
+        if scale is not None:
+            inv = 1.0 / scale
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            loss = loss * inv
+        return grads, loss, new_state, aux
 
     def spmd_step(params, model_state, opt_state, batch, key):
         # fold over the DATA axis only: model-axis ranks run the same
         # replicated computation and must share keys (dropout identity)
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        local = grads_and_metrics if accum_steps == 1 else accumulate
-        grads, loss, new_state, aux = local(params, model_state, batch, key)
+        scale = scale_fn(opt_state) if scale_fn is not None else None
+        gm = functools.partial(grads_and_metrics, scale=scale)
+        if accum_steps == 1:
+            grads, loss, new_state, aux = gm(params, model_state, batch, key)
+        else:
+            grads, loss, new_state, aux = accumulate_microbatches(
+                gm, params, model_state, batch, key, accum_steps
+            )
         grads = average_gradients(grads, axis_name, backend=grad_reduce)
         loss = lax.pmean(loss, axis_name)
         for ax in extra_grad_axes:
@@ -264,6 +287,12 @@ def make_stateful_train_step(
             aux = _pmean_float_leaves(aux, ax)
         new_state = _pmean_float_leaves(new_state, axis_name)
         aux = _pmean_float_leaves(aux, axis_name)
+        if scale_fn is not None:
+            # Guarded step: a non-finite LOSS must trip the skip even in
+            # the corner where every gradient stays finite (e.g. the NaN
+            # arises in a branch with zero cotangent) — poison the grads
+            # so the guard's finite check sees it.
+            grads = _poison(grads, ~jnp.isfinite(loss))
         params, opt_state = optimizer.update(params, grads, opt_state)
         return params, new_state, opt_state, loss, aux
 

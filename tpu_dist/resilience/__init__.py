@@ -1,0 +1,68 @@
+"""`tpu_dist.resilience` — fault tolerance: chaos injection, retry/
+backoff, NaN-guarded training, preemption-safe resume.
+
+The reference stack (and the seed of this rebuild) assumes every rank
+boots, every collective completes, and every step is finite; this package
+holds everything that relaxes those assumptions:
+
+- `chaos` — deterministic fault injection via ``TPU_DIST_CHAOS`` (delay/
+  kill ranks at launch, fail rendezvous attempts, NaN a gradient step,
+  truncate a checkpoint) so the failure paths are exercisable anywhere.
+- `retry` — bounded exponential backoff with jitter (`retry_call`,
+  `RetryPolicy`) and the typed failures `RendezvousTimeout` /
+  `WorkerFailed`; wired into `comm.init` and the `comm.launch`
+  supervisor.
+- `guards` — `nan_guard`: fused non-finite skip-and-count with dynamic
+  loss-scale backoff, inside the compiled train step.
+- `preempt` — `PreemptionGuard`: SIGTERM/SIGINT → checkpoint at the next
+  step boundary (paired with `train.checkpoint.latest_intact`).
+
+See docs/resilience.md for the chaos grammar and the resume contract.
+
+This module stays import-light (stdlib only) because the bootstrap paths
+(`comm.init`, `comm.launch._child`) import it before JAX loads; `guards`
+(which needs jax) loads lazily on first attribute access.
+"""
+
+from __future__ import annotations
+
+from tpu_dist.resilience import chaos, preempt, retry
+from tpu_dist.resilience.chaos import ChaosInjected, ChaosSpec
+from tpu_dist.resilience.preempt import PreemptionGuard
+from tpu_dist.resilience.retry import (
+    RendezvousTimeout,
+    RetryPolicy,
+    WorkerFailed,
+    retry_call,
+)
+
+__all__ = [
+    "ChaosInjected",
+    "ChaosSpec",
+    "PreemptionGuard",
+    "RendezvousTimeout",
+    "RetryPolicy",
+    "WorkerFailed",
+    "bad_steps",
+    "chaos",
+    "guards",
+    "loss_scale",
+    "nan_guard",
+    "preempt",
+    "retry",
+    "retry_call",
+]
+
+
+def __getattr__(name: str):
+    # `guards` imports jax + train.optim; loading it at package-import
+    # time would both slow the pre-JAX bootstrap paths and create an
+    # import cycle through tpu_dist.train.  importlib, not a from-import:
+    # `from tpu_dist.resilience import guards` re-enters this __getattr__
+    # while the submodule is mid-import (infinite recursion).
+    if name in ("guards", "nan_guard", "bad_steps", "loss_scale"):
+        import importlib
+
+        guards = importlib.import_module("tpu_dist.resilience.guards")
+        return guards if name == "guards" else getattr(guards, name)
+    raise AttributeError(f"module 'tpu_dist.resilience' has no attribute {name!r}")

@@ -1,0 +1,196 @@
+"""Deterministic fault injection — the chaos harness.
+
+At pod scale preemption and transient failure are the common case, not
+the exception, so the resilience layer (retry/backoff in `comm.init`,
+the launch supervisor in `comm.launch`, NaN guards in the train step,
+checksum-validated checkpoints) needs a way to be EXERCISED on a laptop:
+this module injects the failures those layers exist to absorb, driven by
+one env var so the same knobs work in tests, demos, and ad-hoc runs:
+
+    TPU_DIST_CHAOS="<clause>[,<clause>...]"
+
+Clause grammar (all values integers/floats; unknown clauses raise):
+
+    rdzv_fail=N          fail the first N rendezvous attempts in this
+                         process (raises `ChaosInjected`; the retry loop
+                         in `comm.init` absorbs them with backoff)
+    kill=RANK[@ATTEMPT]  at launch, rank RANK hard-exits (``os._exit``)
+                         on launch attempt ATTEMPT (default 0) — the
+                         supervisor's ``restarts=`` path relaunches the
+                         gang, and the killed rank survives attempt 1
+    delay=RANK:SECONDS   at launch, rank RANK sleeps SECONDS before
+                         init (straggler simulation)
+    nan_step=K           poison the gradient pytree at optimizer update
+                         K (consumed by `resilience.guards.nan_guard`
+                         inside the compiled step — skip-and-count)
+    ckpt_truncate=FRAC   truncate the NEXT checkpoint file this process
+                         writes to FRAC of its bytes (one-shot) — a
+                         mid-write kill, for `checkpoint.latest_intact`
+    seed=N               seed recorded on the spec for any randomized
+                         knobs (reserved; injection is deterministic)
+
+Everything here is stdlib-only and import-light: the hooks are called
+from bootstrap paths (`comm.launch._child`) that run before JAX loads.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+ENV_VAR = "TPU_DIST_CHAOS"
+# Set by the launch supervisor for each relaunch attempt so kill clauses
+# can be scoped to one attempt (children read it via `launch_attempt`).
+ATTEMPT_ENV_VAR = "TPU_DIST_CHAOS_ATTEMPT"
+
+
+class ChaosInjected(RuntimeError):
+    """An injected (not organic) failure — raised where the spec says a
+    real failure would have happened."""
+
+
+@dataclass(frozen=True)
+class ChaosSpec:
+    """Parsed `TPU_DIST_CHAOS` clauses (see module docstring grammar)."""
+
+    rdzv_fail: int = 0
+    kill: dict[int, int] = field(default_factory=dict)  # rank -> attempt
+    delay: dict[int, float] = field(default_factory=dict)  # rank -> seconds
+    nan_step: int | None = None
+    ckpt_truncate: float | None = None
+    seed: int = 0
+
+
+def parse(spec: str) -> ChaosSpec:
+    """Parse a chaos spec string.  Raises ValueError on unknown clauses or
+    malformed values — a typo'd chaos spec must fail loudly, not silently
+    inject nothing."""
+    rdzv_fail, nan_step, ckpt_truncate, seed = 0, None, None, 0
+    kill: dict[int, int] = {}
+    delay: dict[int, float] = {}
+    for clause in spec.split(","):
+        clause = clause.strip()
+        if not clause:
+            continue
+        key, sep, value = clause.partition("=")
+        if not sep:
+            raise ValueError(f"chaos clause {clause!r} is not key=value")
+        try:
+            if key == "rdzv_fail":
+                rdzv_fail = int(value)
+            elif key == "kill":
+                rank_s, _, attempt_s = value.partition("@")
+                kill[int(rank_s)] = int(attempt_s) if attempt_s else 0
+            elif key == "delay":
+                rank_s, sep2, sec_s = value.partition(":")
+                if not sep2:
+                    raise ValueError("delay needs RANK:SECONDS")
+                delay[int(rank_s)] = float(sec_s)
+            elif key == "nan_step":
+                nan_step = int(value)
+            elif key == "ckpt_truncate":
+                ckpt_truncate = float(value)
+                if not 0.0 <= ckpt_truncate < 1.0:
+                    raise ValueError("ckpt_truncate must be in [0, 1)")
+            elif key == "seed":
+                seed = int(value)
+            else:
+                raise ValueError(f"unknown chaos clause {key!r}")
+        except ValueError as e:
+            raise ValueError(
+                f"bad chaos clause {clause!r} in {ENV_VAR}={spec!r}: {e}"
+            ) from None
+    return ChaosSpec(
+        rdzv_fail=rdzv_fail, kill=kill, delay=delay, nan_step=nan_step,
+        ckpt_truncate=ckpt_truncate, seed=seed,
+    )
+
+
+def active() -> ChaosSpec | None:
+    """The spec from the environment, or None when chaos is off.  Read
+    fresh on every call (tests flip the env var between cases)."""
+    spec = os.environ.get(ENV_VAR)
+    return parse(spec) if spec else None
+
+
+def launch_attempt() -> int:
+    """Which launch/relaunch attempt this process belongs to (set by the
+    `comm.launch` supervisor; 0 outside a supervised launch)."""
+    try:
+        return int(os.environ.get(ATTEMPT_ENV_VAR, "0"))
+    except ValueError:
+        return 0
+
+
+# --- hooks -------------------------------------------------------------------
+
+
+def rendezvous_attempt(attempt: int) -> None:
+    """Gate one rendezvous attempt: raises `ChaosInjected` while
+    ``attempt < rdzv_fail``.  Called by the retry loop in `comm.init`
+    with its attempt index, so every process with the same spec fails
+    (and backs off) in lockstep."""
+    spec = active()
+    if spec is not None and attempt < spec.rdzv_fail:
+        raise ChaosInjected(
+            f"chaos: rendezvous attempt {attempt} failed "
+            f"(rdzv_fail={spec.rdzv_fail})"
+        )
+
+
+def at_launch(rank: int) -> None:
+    """Launch-time injection for one child rank: sleep (``delay=``) or
+    hard-exit (``kill=``, scoped to `launch_attempt`).  Called by
+    `comm.launch._child` before any init work."""
+    spec = active()
+    if spec is None:
+        return
+    if rank in spec.delay:
+        import time
+
+        time.sleep(spec.delay[rank])
+    if spec.kill.get(rank) == launch_attempt():
+        # A hard exit, not an exception: the parent must observe a child
+        # that died without reporting — the failure mode the supervisor
+        # detects via pipe EOF.
+        os._exit(17)
+
+
+def nan_injection_step() -> int | None:
+    """The optimizer-update index at which `guards.nan_guard` poisons the
+    gradient pytree (None = no injection).  Read once at wrapper build
+    time — set the env var before constructing the trainer."""
+    spec = active()
+    return spec.nan_step if spec is not None else None
+
+
+_truncate_armed = True
+
+
+def maybe_truncate_checkpoint(path) -> bool:
+    """One-shot hook called by `train.checkpoint.save` after a write: if
+    the spec has ``ckpt_truncate``, truncate the file in place (simulating
+    a kill mid-write) and disarm.  Returns True when it fired."""
+    global _truncate_armed
+    spec = active()
+    if spec is None or spec.ckpt_truncate is None or not _truncate_armed:
+        return False
+    _truncate_armed = False
+    truncate_file(path, spec.ckpt_truncate)
+    return True
+
+
+def truncate_file(path, frac: float = 0.5) -> None:
+    """Truncate ``path`` to ``frac`` of its bytes — the on-disk state a
+    preemption mid-write leaves behind."""
+    path = Path(path)
+    size = path.stat().st_size
+    with open(path, "r+b") as f:
+        f.truncate(int(size * frac))
+
+
+def reset() -> None:
+    """Re-arm one-shot injections (tests run many cases per process)."""
+    global _truncate_armed
+    _truncate_armed = True

@@ -1,0 +1,219 @@
+"""Step guards — fused non-finite detection with skip-and-count.
+
+One NaN step kills an unguarded run: the optimizer writes NaN into every
+parameter and the remaining epochs train garbage.  `nan_guard` wraps any
+`tpu_dist.train.optim.Optimizer` so the whole check runs INSIDE the
+compiled train step: the gradient pytree is reduced to a single
+all-finite predicate, the inner update is computed, and ``where`` selects
+old-vs-new params and optimizer state — a bad step is skipped (params and
+inner state bit-identical to before), counted (``bad_steps``), and
+training continues.
+
+For bf16 compute the guard also carries a dynamic loss scale with
+escalating backoff: on every bad step ``scale *= backoff``; after
+``growth_interval`` consecutive good steps ``scale *= growth`` (clamped
+to ``[min_scale, max_scale]``).  The replicated step builders
+(`parallel.make_stateful_train_step` and its wrappers) read the live
+scale via ``current_scale`` and thread it through the loss/grad
+computation (scaled backward, unscaled grads + reported loss); under the
+FSDP/ZeRO-1 builders the guard provides skip-and-count only (the sharded
+builders do not thread a scale — documented in docs/resilience.md).
+
+Chaos: when ``TPU_DIST_CHAOS`` has a ``nan_step=K`` clause at wrapper
+construction time, the guard itself poisons the (post-reduce) gradient
+pytree at update K — the injection travels the exact path a real NaN
+would, so the skip semantics are testable end to end.
+
+Follows the wrapper precedent of `train.optim.clip_by_global_norm` /
+`with_ema`: state nests the inner optimizer's under ``"inner"`` plus the
+guard scalars, so checkpointing works unchanged.  Apply `nan_guard`
+OUTERMOST (e.g. ``nan_guard(clip_by_global_norm(adamw(...), 1.0))``) —
+the step builders discover ``current_scale`` on the top-level optimizer.
+"""
+
+from __future__ import annotations
+
+import functools
+import operator
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.train.optim import Optimizer, _inner_sharded
+
+
+@dataclass(frozen=True)
+class GuardedOptimizer(Optimizer):
+    """An `Optimizer` whose state carries guard scalars; ``current_scale``
+    lets the step builders read the live loss scale from the state."""
+
+    current_scale: Callable[[Any], Any] | None = None
+
+
+def _all_finite(grads: Any) -> jax.Array:
+    """One boolean: every element of every floating leaf is finite."""
+    checks = [
+        jnp.all(jnp.isfinite(g))
+        for g in jax.tree.leaves(grads)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+    ]
+    if not checks:
+        return jnp.array(True)
+    return functools.reduce(operator.and_, checks)
+
+
+def _select(ok: jax.Array, new: Any, old: Any) -> Any:
+    """``where(ok, new, old)`` leafwise — the skip."""
+    return jax.tree.map(lambda n, o: jnp.where(ok, n, o), new, old)
+
+
+def _poison(grads: Any, cond: jax.Array) -> Any:
+    """NaN every floating gradient leaf where ``cond`` (chaos injection)."""
+    return jax.tree.map(
+        lambda g: jnp.where(cond, jnp.asarray(jnp.nan, g.dtype), g)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+        else g,
+        grads,
+    )
+
+
+def nan_guard(
+    optimizer: Optimizer,
+    *,
+    init_scale: float = 1.0,
+    backoff: float = 0.5,
+    growth: float = 2.0,
+    growth_interval: int = 200,
+    min_scale: float = 1.0,
+    max_scale: float = 2.0**16,
+) -> GuardedOptimizer:
+    """Wrap ``optimizer`` with fused non-finite skip-and-count plus a
+    dynamic loss scale (see module docstring).
+
+    State: ``{"inner": <wrapped state>, "step", "bad_steps",
+    "good_streak", "scale"}`` — all scalars device-resident, so the guard
+    adds no host sync to the step.  Read the counters back with
+    `bad_steps` / `loss_scale` (also re-exported via `train.metrics`).
+    """
+    if not 0.0 < backoff < 1.0:
+        raise ValueError(f"backoff must be in (0, 1), got {backoff}")
+    if growth < 1.0:
+        raise ValueError(f"growth must be >= 1, got {growth}")
+    if growth_interval < 1:
+        raise ValueError(
+            f"growth_interval must be >= 1, got {growth_interval}"
+        )
+    if not min_scale <= init_scale <= max_scale:
+        raise ValueError(
+            f"need min_scale <= init_scale <= max_scale, got "
+            f"{min_scale} / {init_scale} / {max_scale}"
+        )
+    from tpu_dist.resilience import chaos
+
+    # Static at trace time: the injection compiles into the step (or
+    # compiles away entirely when chaos is off).
+    inject_step = chaos.nan_injection_step()
+
+    def init(params):
+        return {
+            "inner": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+            "bad_steps": jnp.zeros((), jnp.int32),
+            "good_streak": jnp.zeros((), jnp.int32),
+            "scale": jnp.asarray(init_scale, jnp.float32),
+        }
+
+    def _guard_scalars(state, ok):
+        good_streak = jnp.where(ok, state["good_streak"] + 1, 0)
+        grow = ok & (good_streak >= growth_interval)
+        good_streak = jnp.where(grow, 0, good_streak)
+        scale = jnp.where(
+            ok,
+            jnp.where(grow, state["scale"] * growth, state["scale"]),
+            state["scale"] * backoff,
+        )
+        return {
+            "step": state["step"] + 1,
+            "bad_steps": state["bad_steps"] + jnp.where(ok, 0, 1),
+            "good_streak": good_streak,
+            "scale": jnp.clip(scale, min_scale, max_scale),
+        }
+
+    def _maybe_inject(grads, state):
+        if inject_step is None:
+            return grads
+        return _poison(grads, state["step"] == inject_step)
+
+    def update(params, grads, state):
+        grads = _maybe_inject(grads, state)
+        ok = _all_finite(grads)
+        # Compute-then-select (the optax.apply_if_finite pattern): the
+        # inner update runs unconditionally — NaNs in its outputs are
+        # discarded by the select, never stored.
+        new_params, new_inner = optimizer.update(params, grads, state["inner"])
+        return _select(ok, new_params, params), {
+            "inner": _select(ok, new_inner, state["inner"]),
+            **_guard_scalars(state, ok),
+        }
+
+    # Sharded form: finiteness is a GLOBAL property — one rank's NaN
+    # shard must skip the update on every rank, so the non-finite count
+    # is psum'd over the data axis before the select (same shape as
+    # clip_by_global_norm's psum of squared shard norms).
+    inner_sharded = _inner_sharded(optimizer)
+    if inner_sharded is not None:
+        def shard_update(params, grads, state, axis_name):
+            from jax import lax
+
+            grads = _maybe_inject(grads, state)
+            bad_local = sum(
+                jnp.sum(~jnp.isfinite(g)).astype(jnp.float32)
+                for g in jax.tree.leaves(grads)
+                if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+            )
+            ok = lax.psum(bad_local, axis_name) == 0
+            new_params, new_inner = inner_sharded(
+                params, grads, state["inner"], axis_name
+            )
+            return _select(ok, new_params, params), {
+                "inner": _select(ok, new_inner, state["inner"]),
+                **_guard_scalars(state, ok),
+            }
+    else:
+        shard_update = None
+
+    return GuardedOptimizer(
+        init, update, elementwise=False, shard_update=shard_update,
+        current_scale=lambda state: state["scale"],
+    )
+
+
+def _guard_state(tree: Any):
+    """The `nan_guard` scalar dict inside an optimizer state, or None.
+    Anchored on the ``bad_steps`` key (unique to the guard) so parameter
+    trees that legitimately contain ``"scale"`` leaves (LayerNorm
+    mirrors in adamw's m/v) never false-positive."""
+    if isinstance(tree, dict):
+        if "bad_steps" in tree and "scale" in tree:
+            return tree
+        for v in tree.values():
+            found = _guard_state(v)
+            if found is not None:
+                return found
+    return None
+
+
+def bad_steps(opt_state: Any) -> int | None:
+    """Cumulative skipped-step count from a `nan_guard` optimizer state
+    (None when the state is unguarded)."""
+    g = _guard_state(opt_state)
+    return None if g is None else int(g["bad_steps"])
+
+
+def loss_scale(opt_state: Any) -> float | None:
+    """Live dynamic loss scale from a `nan_guard` optimizer state (None
+    when unguarded)."""
+    g = _guard_state(opt_state)
+    return None if g is None else float(g["scale"])

@@ -29,19 +29,52 @@ def _flatten_with_paths(tree: Any):
     return leaves, flat[1]
 
 
+def _tree_digest(paths: list[str], arrays: list[np.ndarray]) -> str:
+    """sha256 over keypaths, shapes, and raw leaf bytes, in leaf order.
+
+    Deliberately dtype-blind: extension dtypes (bfloat16/fp8) round-trip
+    through npz as raw void with the same bytes but a different dtype
+    name, and the digest must survive that — the bytes are the payload.
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    for k, a in zip(paths, arrays, strict=True):
+        a = np.asarray(a)
+        h.update(k.encode())
+        h.update(str(a.shape).encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()
+
+
 def save(path: str | Path, tree: Any, *, step: int = 0) -> None:
     """Single-writer save of a (replicated) pytree.  Only process 0 writes
-    in a multi-process setting — replicas are identical (SURVEY.md §2c.6)."""
+    in a multi-process setting — replicas are identical (SURVEY.md §2c.6).
+
+    ``__meta__`` carries a sha256 digest of the leaf bytes; `restore`
+    verifies it, and `latest_intact` uses it to skip truncated/corrupt
+    snapshots when picking a resume point."""
     if jax.process_index() != 0:
         return
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     leaves, _ = _flatten_with_paths(tree)
     arrays = {f"leaf_{i}": np.asarray(x) for i, (_, x) in enumerate(leaves)}
-    meta = {"step": step, "paths": [k for k, _ in leaves]}
+    paths_ = [k for k, _ in leaves]
+    meta = {
+        "step": step,
+        "paths": paths_,
+        "digest": _tree_digest(paths_, list(arrays.values())),
+    }
     tmp = path.with_suffix(".tmp.npz")
     np.savez(tmp, __meta__=json.dumps(meta), **arrays)
     tmp.rename(path)
+    # Chaos (`TPU_DIST_CHAOS=ckpt_truncate=F`): simulate a kill mid-write
+    # by truncating the file we just published — the state latest_intact
+    # must detect and skip.  No-op when chaos is off.
+    from tpu_dist.resilience import chaos as _chaos
+
+    _chaos.maybe_truncate_checkpoint(path)
 
 
 def save_orbax(path: str | Path, tree: Any, *, step: int = 0) -> None:
@@ -630,7 +663,10 @@ def restore_fsdp(path: str | Path, like: Any) -> tuple[Any, int]:
 def restore(path: str | Path, like: Any) -> tuple[Any, int]:
     """Restore into the structure of ``like`` (a template pytree with the
     same treedef, e.g. freshly-initialized params).  Returns
-    ``(tree, step)``."""
+    ``(tree, step)``.  Checkpoints carrying a digest (everything written
+    by `save` since the resilience layer landed) are checksum-verified —
+    a truncated or bit-corrupted file raises instead of silently loading
+    garbage; digest-less legacy files load unverified."""
     path = Path(path)
     with np.load(path, allow_pickle=False) as data:
         meta = json.loads(str(data["__meta__"]))
@@ -641,4 +677,84 @@ def restore(path: str | Path, like: Any) -> tuple[Any, int]:
                 f"{meta['paths'][:3]}... vs {[k for k, _ in leaves_like][:3]}..."
             )
         leaves = [data[f"leaf_{i}"] for i in range(len(meta["paths"]))]
+    digest = meta.get("digest")
+    if digest is not None and _tree_digest(meta["paths"], leaves) != digest:
+        raise ValueError(
+            f"checkpoint {path} failed checksum validation (truncated or "
+            f"corrupt) — use latest_intact() to find the newest valid "
+            f"snapshot"
+        )
     return jax.tree_util.tree_unflatten(treedef, leaves), meta["step"]
+
+
+def _inspect(path: Path) -> int | None:
+    """One-pass integrity check: the stored step when ``path`` is a
+    readable, internally-consistent checkpoint, else None.
+
+    ``.npz`` files: the archive must parse, every referenced leaf must be
+    present, and the stored digest (when present) must match the bytes.
+    Sharded DIRECTORY checkpoints: ``meta.json`` must parse and every
+    referenced shard blob must load with its recorded shape.  Any failure
+    mode — truncation, a missing shard, bit rot under the digest — maps
+    to None, never an exception."""
+    try:
+        if path.is_dir():
+            meta = read_meta(path)
+            for i, rec in enumerate(meta["leaves"]):
+                for shard in rec["shards"]:
+                    with np.load(path / f"leaf_{i}" / shard["file"]) as z:
+                        data, shape = z["data"], z["shape"]
+                        if data.size != int(np.prod(shape)) * (
+                            np.dtype(rec["dtype"]).itemsize
+                        ):
+                            return None
+            return int(meta["step"])
+        with np.load(path, allow_pickle=False) as data:
+            meta = json.loads(str(data["__meta__"]))
+            leaves = [data[f"leaf_{i}"] for i in range(len(meta["paths"]))]
+        digest = meta.get("digest")
+        if digest is not None and _tree_digest(meta["paths"], leaves) != digest:
+            return None
+        return int(meta["step"])
+    except Exception:
+        return None
+
+
+def verify(path: str | Path) -> bool:
+    """True iff ``path`` is a readable, internally-consistent checkpoint
+    (see `_inspect` for what is checked) — the predicate `latest_intact`
+    scans with."""
+    return _inspect(Path(path)) is not None
+
+
+def latest_intact(
+    directory: str | Path, pattern: str = "*ckpt_*"
+) -> Path | None:
+    """The newest VALID checkpoint under ``directory`` — the `--resume`
+    entry point that survives preemption mid-write.
+
+    Scans entries matching ``pattern`` (both ``ckpt_<n>.npz`` files and
+    sharded ``ckpt_<n>`` directories), validating each in one pass;
+    candidates are ranked by stored step (descending), then mtime — so a
+    truncated newest snapshot is skipped and resume lands on the
+    freshest state that actually loads.  Returns None when nothing valid
+    exists.
+    """
+    directory = Path(directory)
+    if not directory.is_dir():
+        return None
+    best: tuple[int, float, Path] | None = None
+    for cand in directory.glob(pattern):
+        if cand.name.endswith((".tmp", ".tmp.npz")):
+            continue  # in-flight writes are not candidates
+        step = _inspect(cand)
+        if step is None:
+            continue
+        try:
+            mtime = cand.stat().st_mtime
+        except OSError:
+            continue
+        key = (step, mtime, cand)
+        if best is None or key[:2] > best[:2]:
+            best = key
+    return best[2] if best is not None else None

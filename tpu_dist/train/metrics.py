@@ -83,6 +83,24 @@ def device_memory_stats(device=None) -> dict | None:
     return dict(stats) if stats else None
 
 
+def bad_steps(opt_state) -> int | None:
+    """Cumulative skipped-step count from a `resilience.nan_guard`
+    optimizer state — the observable that says HOW OFTEN the run hit
+    non-finite gradients (None when the state is unguarded).  Reading it
+    syncs one device scalar; cheap next to the per-step loss readback."""
+    from tpu_dist.resilience import guards
+
+    return guards.bad_steps(opt_state)
+
+
+def loss_scale(opt_state) -> float | None:
+    """Live dynamic loss scale from a `resilience.nan_guard` optimizer
+    state (None when unguarded)."""
+    from tpu_dist.resilience import guards
+
+    return guards.loss_scale(opt_state)
+
+
 def compiled_memory_analysis(fn, *args) -> dict | None:
     """Compile ``fn`` for ``args`` and report XLA's memory plan:
     argument/output/temp/code sizes in bytes.  Works on every backend

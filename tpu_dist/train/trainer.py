@@ -67,6 +67,13 @@ class TrainConfig:
     # 'int8' / 'fp8' (quantized, 4x less ICI traffic, lossy at gradient-
     # noise level).  Replicated-DP mode only.
     grad_reduce: str = "psum"
+    # NaN guard (resilience.nan_guard): fused non-finite detection on
+    # loss/grads inside the compiled step — a bad step is skipped
+    # (params/opt state unchanged), counted (EpochStats.bad_steps), and
+    # training continues.  loss_scale arms the dynamic bf16 loss scale
+    # (escalating backoff on overflow); replicated-DP mode only.
+    nan_guard: bool = False
+    loss_scale: float | None = None
 
 
 @dataclass
@@ -76,6 +83,8 @@ class EpochStats:
     seconds: float
     samples_per_sec: float
     eval_accuracy: float | None = None
+    # cumulative non-finite steps skipped by the NaN guard (None = guard off)
+    bad_steps: int | None = None
 
 
 class Trainer:
@@ -97,6 +106,29 @@ class Trainer:
         self.world = int(np.prod(mesh.devices.shape))
         self.optimizer = optimizer or sgd(self.config.lr, self.config.momentum)
         self._loss = loss
+        if self.config.loss_scale is not None and not self.config.nan_guard:
+            raise ValueError("loss_scale requires nan_guard=True")
+        if self.config.nan_guard:
+            if self.config.loss_scale is not None and (
+                self.config.fsdp or self.config.zero1
+            ):
+                raise ValueError(
+                    "loss_scale is not threaded through the fsdp/zero1 "
+                    "step builders — use nan_guard without loss_scale "
+                    "there (skip-and-count still applies)"
+                )
+            from tpu_dist.resilience.guards import nan_guard
+
+            # Outermost wrapper: the step builder reads current_scale
+            # from the top-level optimizer.  Without loss_scale the guard
+            # is skip-and-count ONLY — pin the scale to 1.0 (max_scale
+            # clamps growth) so no scaling ever arms itself.
+            if self.config.loss_scale is None:
+                self.optimizer = nan_guard(self.optimizer, max_scale=1.0)
+            else:
+                self.optimizer = nan_guard(
+                    self.optimizer, init_scale=self.config.loss_scale
+                )
 
         # torch.manual_seed(1234) analog: all replicas share this init key.
         key = jax.random.key(self.config.seed)
@@ -293,54 +325,88 @@ class Trainer:
             )
         history = []
         step_key = jax.random.key(cfg.seed + 1)
+        from tpu_dist.resilience.preempt import PreemptionGuard
         from tpu_dist.train.checkpoint import AsyncCheckpointer
 
         ckpt_writer = AsyncCheckpointer() if checkpoint_dir is not None else None
-        for epoch in range(start_epoch, epochs if epochs is not None else cfg.epochs):
-            t0 = time.perf_counter()
-            total_loss, num_batches = 0.0, 0
-            with metrics_mod.trace(trace_dir if epoch == start_epoch else None):
-                batches = prefetch_to_mesh(
-                    loader.epoch(epoch), self.mesh,
-                    axis_name=self.mesh.axis_names[0],
-                )
-                for bi, batch in enumerate(batches):
-                    # fold epoch and batch index separately: no collisions
-                    # however many steps an epoch has
-                    key = jax.random.fold_in(
-                        jax.random.fold_in(step_key, epoch), bi
+        suffix = "" if self._sharded_mode else ".npz"
+        with PreemptionGuard() as preempt:
+            for epoch in range(
+                start_epoch, epochs if epochs is not None else cfg.epochs
+            ):
+                t0 = time.perf_counter()
+                total_loss, num_batches = 0.0, 0
+                with metrics_mod.trace(trace_dir if epoch == start_epoch else None):
+                    batches = prefetch_to_mesh(
+                        loader.epoch(epoch), self.mesh,
+                        axis_name=self.mesh.axis_names[0],
                     )
-                    (
-                        self.params,
-                        self.model_state,
-                        self.opt_state,
-                        loss,
-                        _,
-                    ) = self.step(
-                        self.params, self.model_state, self.opt_state, batch, key
+                    for bi, batch in enumerate(batches):
+                        # fold epoch and batch index separately: no collisions
+                        # however many steps an epoch has
+                        key = jax.random.fold_in(
+                            jax.random.fold_in(step_key, epoch), bi
+                        )
+                        (
+                            self.params,
+                            self.model_state,
+                            self.opt_state,
+                            loss,
+                            _,
+                        ) = self.step(
+                            self.params, self.model_state, self.opt_state, batch, key
+                        )
+                        total_loss += float(loss)
+                        num_batches += 1
+                        if preempt.requested:
+                            break
+                if preempt.requested:
+                    # Step boundary after SIGTERM/SIGINT: write one
+                    # synchronous checkpoint for the CURRENT (incomplete)
+                    # epoch — restore() returns this epoch, so resume
+                    # redoes it from its first batch — and stop cleanly.
+                    if checkpoint_dir is not None:
+                        if ckpt_writer is not None:
+                            ckpt_writer.wait()
+                        self.save(
+                            f"{checkpoint_dir}/ckpt_preempt{suffix}",
+                            epoch=epoch,
+                        )
+                    cfg.log(
+                        f"preemption ({preempt.signal_name}) at epoch "
+                        f"{epoch} step {num_batches}: "
+                        + (
+                            "checkpoint written, stopping"
+                            if checkpoint_dir is not None
+                            else "no checkpoint_dir, stopping"
+                        )
                     )
-                    total_loss += float(loss)
-                    num_batches += 1
-            dt = time.perf_counter() - t0
-            mean_loss = total_loss / max(num_batches, 1)
-            sps = num_batches * cfg.global_batch / dt
-            # train_dist.py:125-127 observable — one line stands for all
-            # (identical) ranks.
-            acc = None
-            if eval_dataset is not None:
-                acc = self.evaluate(eval_dataset)
-            cfg.log(
-                f"Rank all (x{self.world} identical replicas), epoch {epoch}: "
-                f"{mean_loss:.4f}  [{sps:,.0f} samples/s]"
-                + (f"  eval acc {acc:.4f}" if acc is not None else "")
-            )
-            history.append(EpochStats(epoch, mean_loss, dt, sps, acc))
-            if checkpoint_dir is not None:
-                suffix = "" if self._sharded_mode else ".npz"
-                self.save(
-                    f"{checkpoint_dir}/ckpt_{epoch}{suffix}", epoch=epoch + 1,
-                    async_writer=ckpt_writer,
+                    break
+                dt = time.perf_counter() - t0
+                mean_loss = total_loss / max(num_batches, 1)
+                sps = num_batches * cfg.global_batch / dt
+                # train_dist.py:125-127 observable — one line stands for all
+                # (identical) ranks.
+                acc = None
+                if eval_dataset is not None:
+                    acc = self.evaluate(eval_dataset)
+                bad = (
+                    metrics_mod.bad_steps(self.opt_state)
+                    if cfg.nan_guard
+                    else None
                 )
+                cfg.log(
+                    f"Rank all (x{self.world} identical replicas), epoch {epoch}: "
+                    f"{mean_loss:.4f}  [{sps:,.0f} samples/s]"
+                    + (f"  eval acc {acc:.4f}" if acc is not None else "")
+                    + (f"  bad_steps {bad}" if bad else "")
+                )
+                history.append(EpochStats(epoch, mean_loss, dt, sps, acc, bad))
+                if checkpoint_dir is not None:
+                    self.save(
+                        f"{checkpoint_dir}/ckpt_{epoch}{suffix}", epoch=epoch + 1,
+                        async_writer=ckpt_writer,
+                    )
         if ckpt_writer is not None:
             ckpt_writer.wait()
         return history

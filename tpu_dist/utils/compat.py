@@ -1,0 +1,42 @@
+"""JAX version compatibility shims.
+
+The codebase targets the modern public API (``jax.shard_map`` with the
+``check_vma`` kwarg).  On older installs (< 0.5) that entry point still
+lives at ``jax.experimental.shard_map.shard_map`` and the kwarg is named
+``check_rep`` — semantically the same toggle.  `install` bridges the gap
+once, at ``tpu_dist`` import time, so every call site can use the modern
+spelling unconditionally.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    """Idempotently install missing modern-API aliases onto ``jax``."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, **kwargs):
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            return _shard_map(f, **kwargs)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        # Pre-0.5 spelling: the size of a mapped axis is psum(1) over it
+        # (constant-folded by XLA, so this compiles to the same program).
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and hasattr(
+            pltpu, "TPUCompilerParams"
+        ):
+            # Renamed upstream; same dataclass.
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pallas not available on this install
+        pass
