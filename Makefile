@@ -9,10 +9,13 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 .PHONY: test chaos ptp gather allreduce train bench runtime train-image \
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
-        chaos-resume docs demos
+        chaos-resume docs demos telemetry-demo
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+telemetry-demo:  # short traced training run; asserts the events file parses
+	cd demos && $(PY) telemetry_demo.py --platform $(PLATFORM) --world 4
 
 chaos:  # the fault-injection suite (kill/retry/resume; spawns real gangs)
 	$(PY) -m pytest tests/ -q -m chaos

@@ -28,9 +28,44 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def results_root() -> str:
+    import os
+
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", "results"
+    )
+
+
+def persist_event(record: dict, *, root: str | None = None,
+                  out_name: str = "bench_runs.jsonl") -> str:
+    """Append one structured record to ``benchmarks/results/<out_name>``
+    with timestamp, run id, and platform provenance attached — every
+    bench invocation leaves a durable, machine-parseable trace (until
+    now BENCH_r05's stderr tail was the only record of a CPU fallback).
+    Returns the file path."""
+    import json as _json
+    import os
+    import time as _time
+
+    from tpu_dist.observe import events as ev_mod
+
+    root = root or results_root()
+    os.makedirs(root, exist_ok=True)
+    path = os.path.join(root, out_name)
+    rec = {
+        "time": _time.time(),
+        "run_id": os.environ.get(ev_mod.ENV_RUN_ID),
+        **record,
+        "provenance": ev_mod.platform_provenance(),
+    }
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(_json.dumps(rec, default=str) + "\n")
+    return path
+
+
 def ensure_live_backend(
     probe_timeout_s: float = 90.0, budget_s: float = 540.0
-) -> None:
+) -> str:
     """Probe the default JAX backend in a SUBPROCESS first: in this
     container the TPU is reached through a tunnel that can hang
     indefinitely at init, which would wedge the whole benchmark.  The
@@ -55,7 +90,7 @@ def ensure_live_backend(
     if os.environ.get("TPU_DIST_PLATFORM") == "cpu":
         pin_cpu()
         log("TPU_DIST_PLATFORM=cpu — pinned CPU, tunnel probe skipped")
-        return
+        return "cpu-pinned"
 
     deadline = time.monotonic() + budget_s
     attempt, detail = 0, ""
@@ -64,7 +99,7 @@ def ensure_live_backend(
         platform, detail = probe_default_backend(probe_timeout_s)
         if platform is not None:
             log(f"backend probe: {platform} (attempt {attempt})")
-            return
+            return platform
         remaining = deadline - time.monotonic()
         if remaining <= 0:
             break
@@ -73,8 +108,25 @@ def ensure_live_backend(
             f"retrying in {pause:.0f}s ({remaining:.0f}s budget left)")
         time.sleep(pause)
     pin_cpu()
+    # Loud AND durable: the human line for the scrollback, a structured
+    # warning event on stderr for log scrapers, and the same record
+    # persisted to benchmarks/results/ so the fallback is attributable
+    # long after this process exits.
     log(f"backend probe failed after {attempt} attempts ({detail}) — "
         "falling back to CPU — numbers are NOT TPU numbers")
+    warning = {
+        "event": "warning",
+        "reason": "cpu_fallback",
+        "detail": detail,
+        "probe_attempts": attempt,
+        "message": "benchmark numbers are NOT TPU numbers",
+    }
+    log(json.dumps(warning))
+    try:
+        persist_event(warning)
+    except Exception as e:
+        log(f"could not persist cpu_fallback warning: {e}")
+    return "cpu-fallback"
 
 
 def last_live_result(out_name: str = "bench.out") -> dict | None:
@@ -279,7 +331,7 @@ def inline_lm_mfu() -> dict | None:
 def main():
     import os
 
-    ensure_live_backend()
+    probe_status = ensure_live_backend()
     value, extras = bench_tpu_dist()
     try:
         baseline = bench_torch_reference()
@@ -291,6 +343,7 @@ def main():
         "value": round(value, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(value / baseline, 2) if baseline else None,
+        "backend_probe": probe_status,
         **extras,
     }
     on_tpu = result.get("platform") == "tpu"
@@ -324,6 +377,10 @@ def main():
                 k: lm.get(k)
                 for k in ("metric", "value", "unit", "best", "captured")
             }
+    try:
+        persist_event({"event": "bench", **result})
+    except Exception as e:
+        log(f"could not persist bench record: {e}")
     print(json.dumps(result))
 
 

@@ -78,6 +78,17 @@ def bench(world, platform, mbytes: float, iters: int):
         results[name] = allreduce_gbps(n * 4, dt, w)
         print(f"{name}: {n*4/1e6:.1f} MB allreduce over {w} ranks: "
               f"{dt*1e3:.2f} ms → {results[name]:.2f} GB/s bus bandwidth")
+        # Achieved collective bandwidth into the structured event log
+        # (no-op when TPU_DIST_TELEMETRY is unset) — the per-step analog
+        # lives in the trainers; this is the isolated-collective record.
+        from tpu_dist.observe import events as ev_mod
+
+        ev_mod.from_env().emit(
+            "bench", metric=f"allreduce_{name}_bus_gbps",
+            value=round(results[name], 3), unit="GB/s", world=w,
+            payload_mb=round(n * 4 / 1e6, 2), seconds=dt,
+            collective_gbps=round(results[name], 3),
+        )
     return results
 
 

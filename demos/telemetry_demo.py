@@ -1,0 +1,87 @@
+"""Telemetry end-to-end: a short traced training run, then verify it.
+
+The `make telemetry-demo` target: runs a tiny `Trainer` fit on the
+CPU-sim mesh with ``TPU_DIST_TELEMETRY`` pointed at a scratch dir,
+then (1) schema-validates every event record (`observe.events`
+validators), (2) asserts the manifest and step records carry the
+documented fields, (3) checks the span trace parses as Chrome-trace
+JSON, and (4) renders one `tools/tpu_top.py` snapshot.  Exits non-zero
+on any violation — this is the executable form of the acceptance
+criterion in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+from _common import parse_args
+
+
+def main() -> int:
+    args = parse_args(
+        default_world=4,
+        epochs=(int, 2, "training epochs"),
+        samples=(int, 512, "synthetic dataset size"),
+        out=(str, "", "telemetry dir (default: fresh temp dir)"),
+    )
+    out = args.out or tempfile.mkdtemp(prefix="tpu_dist_telemetry_")
+    os.environ["TPU_DIST_TELEMETRY"] = out
+
+    from tpu_dist import comm, data, models, train
+    from tpu_dist.observe import events as ev_mod
+
+    world = args.world or 4
+    mesh = comm.make_mesh(world, ("data",), platform=args.platform)
+    ds = data.load_mnist("train", synthetic_size=args.samples)
+    cfg = train.TrainConfig(epochs=args.epochs, nan_guard=True)
+    trainer = train.Trainer(models.mnist_net(), models.IN_SHAPE, mesh, cfg)
+    history = trainer.fit(ds)
+    print(f"trained {len(history)} epochs; telemetry under {out}")
+
+    n, errors = ev_mod.validate_dir(out)
+    if errors:
+        print(f"FAIL: {len(errors)} schema violations in {n} records:")
+        for e in errors[:20]:
+            print(f"  {e}")
+        return 1
+    records = ev_mod.read_events(out)
+    kinds = {r["event"] for r in records}
+    missing = {"manifest", "step", "epoch"} - kinds
+    if missing:
+        print(f"FAIL: no {sorted(missing)} events among {sorted(kinds)}")
+        return 1
+    steps = [r for r in records if r["event"] == "step"]
+    for key in ev_mod.STEP_REQUIRED:
+        if any(key not in s for s in steps):
+            print(f"FAIL: step record missing required key {key!r}")
+            return 1
+    span_path = os.path.join(out, "spans_rank0.trace.json")
+    with open(span_path) as fh:
+        trace = json.load(fh)
+    if not trace.get("traceEvents"):
+        print(f"FAIL: empty span trace at {span_path}")
+        return 1
+    print(
+        f"OK: {n} events validate "
+        f"({len(steps)} steps, {len(trace['traceEvents'])} spans)"
+    )
+
+    sys.path.insert(
+        0,
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools",
+        ),
+    )
+    import tpu_top
+
+    print("--- tpu_top --once ---")
+    print(tpu_top.render(tpu_top.collect(out)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,63 @@ def test_watchdog_fires_on_slow_block(capsys):
     with utils.collective_watchdog(timeout_s=0.05, what="slow-thing") as fired:
         time.sleep(0.3)
     assert fired.is_set()
+    err = capsys.readouterr().err
+    assert "slow-thing" in err and "stalled collective" in err
+
+
+def test_watchdog_fire_emits_stall_event(tmp_path, monkeypatch, capsys):
+    """When telemetry is armed, the stderr scream is mirrored as a
+    machine-parseable ``stall`` event with heartbeat attribution."""
+    import json
+
+    from tpu_dist.observe import events, heartbeat
+
+    d = str(tmp_path / "telemetry")
+    monkeypatch.setenv(events.ENV_DIR, d)
+    monkeypatch.delenv(events.ENV_RANK, raising=False)
+    # rank 1's last progress beat is 9s old — the straggler on record
+    import os
+
+    os.makedirs(d, exist_ok=True)
+    with open(f"{d}/heartbeat_rank1.json", "w") as fh:
+        json.dump({"rank": 1, "time": time.time() - 9.0, "step": 3,
+                   "phase": "train"}, fh)
+    with utils.collective_watchdog(timeout_s=0.05, what="hang") as fired:
+        time.sleep(0.3)
+    assert fired.is_set()
+    assert "rank 1 is" in capsys.readouterr().err
+    stalls = [r for r in events.read_events(d) if r["event"] == "stall"]
+    assert len(stalls) == 1
+    assert stalls[0]["what"] == "hang"
+    assert stalls[0]["ranks_behind"][0]["rank"] == 1
+    assert stalls[0]["ranks_behind"][0]["behind_s"] > 8.0
+
+
+def test_watchdog_explicit_dir_without_env(tmp_path, monkeypatch):
+    """An explicit telemetry_dir must receive the stall event even when
+    TPU_DIST_TELEMETRY is unset."""
+    from tpu_dist.observe import events
+
+    monkeypatch.delenv(events.ENV_DIR, raising=False)
+    d = str(tmp_path / "explicit")
+    with utils.collective_watchdog(
+        timeout_s=0.05, what="explicit-dir", telemetry_dir=d
+    ) as fired:
+        time.sleep(0.3)
+    assert fired.is_set()
+    stalls = [r for r in events.read_events(d) if r["event"] == "stall"]
+    assert len(stalls) == 1 and stalls[0]["what"] == "explicit-dir"
+
+
+def test_watchdog_quiet_block_emits_no_event(tmp_path, monkeypatch):
+    from tpu_dist.observe import events
+
+    d = str(tmp_path / "telemetry")
+    monkeypatch.setenv(events.ENV_DIR, d)
+    with utils.collective_watchdog(timeout_s=5.0, what="fast") as fired:
+        pass
+    assert not fired.is_set()
+    assert not [r for r in events.read_events(d) if r["event"] == "stall"]
 
 
 def test_blocked_until_ready_passthrough():

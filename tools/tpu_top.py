@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""tpu_top — live terminal dashboard over a TPU_DIST_TELEMETRY directory.
+
+Tails the structured JSONL event log (`tpu_dist.observe.events`) plus
+the per-rank heartbeat files and renders one screen: run identity and
+platform, the latest step metrics (loss, step time, samples/s/chip,
+MFU, bad steps, loss scale, HBM), goodput, per-rank heartbeat health,
+and the most recent notable events (retry / chaos / stall / preempt /
+checkpoint / warning).
+
+    python tools/tpu_top.py <telemetry-dir>          # refresh loop
+    python tools/tpu_top.py <telemetry-dir> --once   # one snapshot
+    python tools/tpu_top.py                          # $TPU_DIST_TELEMETRY
+
+Pure stdlib + `tpu_dist.observe` (itself stdlib-only), so it runs on a
+login host with no JAX installed — copy the telemetry dir off the pod
+and point this at it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dist.observe import events as ev_mod  # noqa: E402
+from tpu_dist.observe import heartbeat as hb_mod  # noqa: E402
+
+NOTABLE = ("retry", "chaos", "stall", "preempt", "checkpoint", "warning")
+
+
+def _fmt(value, spec: str = "", none: str = "--") -> str:
+    if value is None:
+        return none
+    try:
+        return format(value, spec)
+    except (TypeError, ValueError):
+        return str(value)
+
+
+def _age(t: float | None, now: float) -> str:
+    return "--" if t is None else f"{max(now - t, 0.0):.1f}s ago"
+
+
+class EventTail:
+    """Incremental event reader: remembers a byte offset per file so a
+    live dashboard frame parses only the lines appended since the last
+    frame (a multi-day events.jsonl must not be re-parsed every 2s).
+    Only complete (newline-terminated) lines are consumed — a torn tail
+    line is left for the next poll."""
+
+    def __init__(self, dirpath: str):
+        self.dir = dirpath
+        self._offsets: dict[str, int] = {}
+
+    def poll(self) -> list:
+        import json
+
+        new = []
+        for path in ev_mod.event_files(self.dir):
+            offset = self._offsets.get(path, 0)
+            try:
+                with open(path, "rb") as fh:
+                    fh.seek(offset)
+                    chunk = fh.read()
+            except OSError:
+                continue
+            end = chunk.rfind(b"\n") + 1
+            self._offsets[path] = offset + end
+            for raw in chunk[:end].splitlines():
+                try:
+                    new.append(json.loads(raw.decode("utf-8")))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+        new.sort(key=lambda r: r.get("time", 0.0))
+        return new
+
+
+def empty_state(dirpath: str) -> dict:
+    return {
+        "dir": dirpath,
+        "manifest": None,
+        "steps": {},       # rank -> last step record
+        "epochs": [],
+        "notable": [],
+        "counts": {},
+        "beats": {},
+    }
+
+
+def update(state: dict, records: list) -> dict:
+    """Fold new event records into the dashboard state, then refresh the
+    (small) heartbeat files, scoped to the newest run: stale files from
+    an earlier run sharing this dir must not render as stalled ranks."""
+    for rec in records:
+        kind = rec.get("event")
+        state["counts"][kind] = state["counts"].get(kind, 0) + 1
+        if kind == "manifest":
+            state["manifest"] = rec  # newest wins
+        elif kind == "step":
+            state["steps"][rec.get("rank", 0)] = rec
+        elif kind == "epoch":
+            state["epochs"].append(rec)
+        if kind in NOTABLE:
+            state["notable"].append(rec)
+            del state["notable"][:-64]  # bounded; render shows the tail
+    run_id = (state["manifest"] or {}).get("run_id")
+    state["beats"] = hb_mod.read(state["dir"], run_id=run_id)
+    return state
+
+
+def collect(dirpath: str) -> dict:
+    """One consistent snapshot of a telemetry dir (the --once path)."""
+    return update(empty_state(dirpath), ev_mod.read_events(dirpath))
+
+
+def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
+    now = time.time() if now is None else now
+    lines = []
+    man = state["manifest"]
+    if man:
+        plat = man.get("platform") or {}
+        lines.append(
+            f"run {man.get('run_id')}  world {man.get('world')}  "
+            f"{man.get('trainer', '?')}  "
+            f"[{plat.get('backend', '?')} x{plat.get('device_count', '?')}"
+            f"{' ' + plat['device_kind'] if plat.get('device_kind') else ''}]"
+            f"  started {_age(man.get('time'), now)}"
+        )
+    else:
+        lines.append(f"(no manifest yet under {state['dir']})")
+
+    for rank in sorted(state["steps"]):
+        s = state["steps"][rank]
+        hbm = s.get("hbm") or {}
+        hbm_s = (
+            f"{hbm['bytes_in_use'] / 1e6:,.0f}MB"
+            if hbm.get("bytes_in_use")
+            else "--"
+        )
+        lines.append(
+            f"rank {rank}  step {_fmt(s.get('step'))}"
+            f"  epoch {_fmt(s.get('epoch'))}"
+            f"  loss {_fmt(s.get('loss'), '.4f')}"
+            f"  {_fmt(s.get('step_time'), '.4f')}s/step"
+            f"  {_fmt(s.get('samples_per_sec_per_chip'), ',.0f')} samples/s/chip"
+            f"  MFU {_fmt(s.get('mfu'), '.2%')}"
+            f"  bad {_fmt(s.get('bad_steps'))}"
+            f"  scale {_fmt(s.get('loss_scale'))}"
+            f"  hbm {hbm_s}"
+            f"  ({_age(s.get('time'), now)})"
+        )
+    if not state["steps"]:
+        lines.append("(no step records yet)")
+
+    if state["epochs"]:
+        e = state["epochs"][-1]
+        g = (e.get("goodput") or {}).get("goodput")
+        lines.append(
+            f"epoch {_fmt(e.get('epoch'))}: mean loss "
+            f"{_fmt(e.get('mean_loss'), '.4f')}  "
+            f"{_fmt(e.get('seconds'), '.1f')}s  goodput {_fmt(g, '.1%')}"
+        )
+
+    if state["beats"]:
+        parts = []
+        for rank in sorted(state["beats"]):
+            b = state["beats"][rank]
+            mark = "done" if b.get("phase") == "done" else (
+                "STALE" if now - b.get("time", 0) > 10.0 else "ok"
+            )
+            parts.append(
+                f"{rank}:{mark}(step {_fmt(b.get('step'))}, "
+                f"{_age(b.get('time'), now)})"
+            )
+        lines.append("ranks  " + "  ".join(parts))
+
+    if state["notable"]:
+        lines.append("recent events:")
+        for rec in state["notable"][-recent:]:
+            detail = {
+                k: v
+                for k, v in rec.items()
+                if k not in ("event", "time", "rank", "run_id")
+            }
+            body = "  ".join(f"{k}={v}" for k, v in detail.items())
+            lines.append(
+                f"  [{_age(rec.get('time'), now):>10}] rank "
+                f"{rec.get('rank')} {rec.get('event'):<10} {body[:120]}"
+            )
+    counts = "  ".join(f"{k}:{v}" for k, v in sorted(state["counts"].items()))
+    lines.append(f"events  {counts or '(none)'}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "dir", nargs="?", default=os.environ.get(ev_mod.ENV_DIR),
+        help="telemetry directory (default: $TPU_DIST_TELEMETRY)",
+    )
+    ap.add_argument("--once", action="store_true",
+                    help="render one snapshot and exit")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (loop mode)")
+    args = ap.parse_args(argv)
+    if not args.dir:
+        ap.error("no telemetry dir given and TPU_DIST_TELEMETRY is unset")
+    if args.once:
+        print(render(collect(args.dir)))
+        return 0
+    # Live mode: incremental tail — each frame parses only appended lines.
+    tail = EventTail(args.dir)
+    state = empty_state(args.dir)
+    try:
+        while True:
+            frame = render(update(state, tail.poll()))
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

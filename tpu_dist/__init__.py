@@ -39,6 +39,7 @@ from tpu_dist import (  # noqa: E402
     export,
     models,
     nn,
+    observe,
     ops,
     parallel,
     resilience,
@@ -54,6 +55,7 @@ __all__ = [
     "export",
     "models",
     "nn",
+    "observe",
     "ops",
     "parallel",
     "resilience",

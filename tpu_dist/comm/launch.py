@@ -37,7 +37,12 @@ def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc,
         # observes a child that died without reporting, which is exactly
         # the failure mode the supervisor exists to detect.
         from tpu_dist.resilience import chaos as _chaos
+        from tpu_dist.observe import events as _events
 
+        # Pin the telemetry rank before anything can open an event log or
+        # heartbeat file: the jax-level rank isn't known yet, and every
+        # rank writing to events.jsonl (rank 0's file) would interleave.
+        os.environ[_events.ENV_RANK] = str(rank)
         os.environ[_chaos.ATTEMPT_ENV_VAR] = str(chaos_attempt)
         _chaos.at_launch(rank)
         if init_method:
@@ -107,19 +112,36 @@ def launch(
     scoped to one attempt.  Exhausted restarts raise
     `resilience.WorkerFailed` with the last failure.
     """
+    from tpu_dist.observe import events as events_mod
     from tpu_dist.resilience.retry import WorkerFailed, logger
 
+    # The gang supervisor's own event stream (events_supervisor.jsonl):
+    # restarts and final failure become machine-parseable records instead
+    # of vanishing into stderr.  NULL logger when telemetry is off.
+    elog = events_mod.from_env(role="supervisor")
     last_error: Exception | None = None
     for attempt in range(restarts + 1):
         try:
-            return _launch_once(
+            results = _launch_once(
                 fn, world, platform=platform, addr=addr, port=port,
                 devices_per_proc=devices_per_proc, timeout=timeout,
                 init_method=init_method, assign_ranks=assign_ranks,
                 attempt=attempt,
             )
+            if attempt > 0:
+                elog.emit(
+                    "retry", what="gang_relaunch", attempt=attempt + 1,
+                    max_attempts=restarts + 1, error=None, world=world,
+                    outcome="succeeded",
+                )
+            return results
         except WorkerFailed as e:
             last_error = e
+            elog.emit(
+                "retry", what="gang_relaunch", attempt=attempt + 1,
+                max_attempts=restarts + 1, error=str(e), world=world,
+                outcome="exhausted" if attempt >= restarts else "relaunching",
+            )
             if attempt >= restarts:
                 break
             logger.warning(

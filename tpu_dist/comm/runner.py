@@ -87,4 +87,43 @@ def spmd(
             placed.append(
                 jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), repl), a)
             )
-    return mapped(*placed)
+    out = mapped(*placed)
+    try:
+        _emit_rank_results(out, n)
+    except Exception:
+        pass  # telemetry must never break a successful spmd call
+    return out
+
+
+def _summarize_leaf(leaf, r: int) -> Any:
+    """Rank ``r``'s slice of one stacked result leaf, JSONL-sized: the
+    value itself when tiny (the per-rank scalars the reference printed),
+    shape/dtype otherwise.  Shape/dtype come from metadata — only the
+    tiny case reads any bytes back from the device."""
+    import math
+
+    import numpy as np
+
+    shape = tuple(leaf.shape[1:])
+    if math.prod(shape) <= 4:
+        return np.asarray(leaf[r]).tolist()
+    return {"shape": list(shape), "dtype": str(leaf.dtype)}
+
+
+def _emit_rank_results(out: Any, world: int) -> None:
+    """The machine-parseable form of the reference's per-rank ``print``
+    (train_dist.py:125-127): with ``TPU_DIST_TELEMETRY`` set, each rank's
+    stacked result slice becomes one ``spmd_result`` event.  No-op (and
+    no device readback) when telemetry is off; stdout is untouched."""
+    from tpu_dist.observe import events as ev_mod
+
+    elog = ev_mod.from_env()
+    if not elog.enabled:
+        return
+    leaves = jax.tree_util.tree_flatten_with_path(out)[0]
+    for r in range(world):
+        summary = {
+            jax.tree_util.keystr(path) or ".": _summarize_leaf(leaf, r)
+            for path, leaf in leaves
+        }
+        elog.emit("spmd_result", spmd_rank=r, summary=summary)

@@ -1,0 +1,25 @@
+"""`tpu_dist.observe` — the unified telemetry subsystem.
+
+The reference's observability story is per-rank ``print`` (SURVEY.md §5);
+before this package ours was scattered timing helpers (`train.metrics`),
+a stderr watchdog (`utils.debug`), and interleaved stdout.  This package
+is the measurement substrate the ROADMAP's perf PRs cite:
+
+- `events`    — per-rank structured JSONL event log (manifest + step /
+                epoch / checkpoint / retry / chaos / stall records),
+                opt-in via ``TPU_DIST_TELEMETRY=<dir>``
+- `registry`  — counters / gauges / histograms with a Prometheus
+                text-exposition endpoint (``TPU_DIST_METRICS_PORT``)
+- `spans`     — host-side span tracing emitted as Chrome-trace JSON,
+                correlated with `jax.profiler` device traces by step id
+- `heartbeat` — per-rank progress heartbeats, stall attribution
+                ("rank N is K seconds behind"), and goodput accounting
+
+Everything here is stdlib-only and import-light: these modules are
+imported from bootstrap paths (`comm.launch._child`,
+`resilience.chaos`) that run before JAX backends initialize.
+"""
+
+from tpu_dist.observe import events, heartbeat, registry, spans
+
+__all__ = ["events", "heartbeat", "registry", "spans"]

@@ -1,0 +1,248 @@
+"""Per-rank heartbeats, stall attribution, and goodput accounting.
+
+A heartbeat here means "this rank made PROGRESS", not "this process is
+alive": `HeartbeatWriter.beat` is called from the training loop (one
+beat per step), so a rank stuck in a collective stops beating and its
+file goes stale.  Rank 0 (or `utils.debug.collective_watchdog`, or
+`tools/tpu_top.py`) aggregates the files with `read` /
+`attribute_stall`, upgrading "something stalled" to "rank N is K
+seconds behind (step S, phase P)".
+
+Files are ``heartbeat_rank<r>.json`` under the ``TPU_DIST_TELEMETRY``
+dir, written atomically (tmp + rename) so readers never see a torn
+record.  Stdlib-only.
+
+`GoodputMeter` is the other half of the accounting: wall-clock time
+bucketed into productive / compile / checkpoint / restart / other, and
+``goodput`` = productive / total — the number that says how much of the
+run the hardware spent training (vs. recovering, compiling, writing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import re
+import time
+
+from tpu_dist.observe import events as _events
+
+_FILE_RE = re.compile(r"^heartbeat_rank(\d+)\.json$")
+
+
+class HeartbeatWriter:
+    """Writes this rank's progress record.  ``beat`` is rate-limited to
+    one write per ``min_interval_s`` unless the step or phase changed —
+    cheap enough to call every training step."""
+
+    def __init__(self, dirpath: str, rank: int = 0, *,
+                 min_interval_s: float = 0.25):
+        self.dir = str(dirpath)
+        self.rank = int(rank)
+        self.min_interval_s = float(min_interval_s)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, f"heartbeat_rank{self.rank}.json")
+        # Stamped into every beat so a reused telemetry dir can't blame
+        # phantom ranks from a previous run's stale files.
+        self.run_id = _events._run_id_for(self.dir)
+        self._last_write = 0.0
+        self._last_state: tuple = ()
+        self.beat(step=None, phase="start")
+
+    def beat(self, step: int | None = None, phase: str | None = None) -> None:
+        now = time.time()
+        state = (step, phase)
+        if (
+            now - self._last_write < self.min_interval_s
+            and state == self._last_state
+        ):
+            return
+        rec = {
+            "rank": self.rank,
+            "time": now,
+            "step": step,
+            "phase": phase,
+            "pid": os.getpid(),
+            "run_id": self.run_id,
+        }
+        tmp = f"{self.path}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(rec, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            return  # a full disk must not kill the training loop
+        self._last_write = now
+        self._last_state = state
+
+    def close(self, phase: str = "done") -> None:
+        step = self._last_state[0] if self._last_state else None
+        self._last_write = 0.0  # force the final write through
+        self._last_state = ()
+        self.beat(step=step, phase=phase)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def from_env(rank: int | None = None) -> HeartbeatWriter | None:
+    """A writer under ``TPU_DIST_TELEMETRY`` for this process's rank, or
+    None when telemetry is off.  NOT cached: each fit() owns its writer
+    lifecycle (start marker through done marker)."""
+    dirpath = os.environ.get(_events.ENV_DIR)
+    if not dirpath:
+        return None
+    return HeartbeatWriter(dirpath, _events.env_rank(rank))
+
+
+def read(dirpath: str, run_id: str | None = None) -> dict[int, dict]:
+    """All ranks' latest heartbeat records, keyed by rank.  With
+    ``run_id``, records stamped with a DIFFERENT id are dropped (stale
+    files from a previous run sharing the telemetry dir); unstamped
+    records are kept (hand-written/legacy)."""
+    beats: dict[int, dict] = {}
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return beats
+    for name in names:
+        m = _FILE_RE.match(name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(dirpath, name), encoding="utf-8") as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if run_id and rec.get("run_id") and rec["run_id"] != run_id:
+            continue
+        beats[int(m.group(1))] = rec
+    return beats
+
+
+def attribute_stall(
+    dirpath: str,
+    *,
+    stale_after_s: float,
+    expected_world: int | None = None,
+    now: float | None = None,
+    run_id: str | None = None,
+) -> list[dict]:
+    """Which ranks are behind, and by how much.
+
+    A rank is BEHIND when its last progress beat is older than
+    ``stale_after_s`` (it stopped advancing) while at least one rank is
+    fresh — if every rank is stale the hang is global (all are
+    reported, so the caller still learns it's not single-rank).  A rank
+    closed as ``done`` is never behind; one closed as ``crashed`` (a fit
+    that raised) stays attributable.  With
+    ``expected_world``, ranks that never wrote a heartbeat are reported
+    too (``missing: true`` — they died or never reached init).  Result
+    is sorted most-behind-first; each entry carries rank / behind_s /
+    step / phase for the "rank N is K seconds behind" message.
+
+    ``run_id`` scopes the attribution to one run's heartbeats (default:
+    this process's current run id, so stale files from a previous run
+    in a reused dir are never blamed).
+    """
+    now = time.time() if now is None else now
+    if run_id is None:
+        run_id = os.environ.get(_events.ENV_RUN_ID)
+    beats = read(dirpath, run_id=run_id)
+    behind = []
+    for rank, rec in beats.items():
+        lag = now - float(rec.get("time", 0.0))
+        if lag > stale_after_s and rec.get("phase") != "done":
+            behind.append(
+                {
+                    "rank": rank,
+                    "behind_s": round(lag, 3),
+                    "step": rec.get("step"),
+                    "phase": rec.get("phase"),
+                    "missing": False,
+                }
+            )
+    if expected_world is not None:
+        for rank in range(expected_world):
+            if rank not in beats:
+                behind.append(
+                    {
+                        "rank": rank,
+                        "behind_s": None,
+                        "step": None,
+                        "phase": None,
+                        "missing": True,
+                    }
+                )
+    behind.sort(
+        key=lambda e: (not e["missing"], -(e["behind_s"] or 0.0), e["rank"])
+    )
+    return behind
+
+
+def describe_stall(behind: list[dict]) -> str:
+    """The operator-facing one-liner for an `attribute_stall` result."""
+    if not behind:
+        return "no per-rank heartbeat attribution available"
+    parts = []
+    for e in behind:
+        if e["missing"]:
+            parts.append(f"rank {e['rank']} has no heartbeat (dead or never initialized)")
+        else:
+            where = f"step {e['step']}" if e["step"] is not None else f"phase {e['phase']}"
+            parts.append(f"rank {e['rank']} is {e['behind_s']:.1f}s behind ({where})")
+    return "; ".join(parts)
+
+
+class Measured:
+    """Yielded by `GoodputMeter.measure`; ``seconds`` is set on exit."""
+
+    seconds: float = 0.0
+
+
+class GoodputMeter:
+    """Wall-clock accounting: productive vs. everything else.
+
+    Categories are free-form strings; the conventional ones are
+    ``productive`` (timed train steps), ``compile`` (first-step tracing/
+    compilation), ``checkpoint``, ``restart``, ``eval``.  ``goodput`` =
+    productive / total accounted time."""
+
+    PRODUCTIVE = "productive"
+
+    def __init__(self):
+        self.seconds: dict[str, float] = {}
+
+    def account(self, category: str, seconds: float) -> None:
+        self.seconds[category] = self.seconds.get(category, 0.0) + float(seconds)
+
+    @contextlib.contextmanager
+    def measure(self, category: str):
+        m = Measured()
+        t0 = time.perf_counter()
+        try:
+            yield m
+        finally:
+            m.seconds = time.perf_counter() - t0
+            self.account(category, m.seconds)
+
+    def total(self) -> float:
+        return sum(self.seconds.values())
+
+    def goodput(self) -> float | None:
+        total = self.total()
+        if total <= 0:
+            return None
+        return self.seconds.get(self.PRODUCTIVE, 0.0) / total
+
+    def summary(self) -> dict:
+        g = self.goodput()
+        return {
+            "seconds": {k: round(v, 4) for k, v in sorted(self.seconds.items())},
+            "total_s": round(self.total(), 4),
+            "goodput": round(g, 4) if g is not None else None,
+        }

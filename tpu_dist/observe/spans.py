@@ -1,0 +1,169 @@
+"""Host-side span tracing — Chrome-trace/perfetto JSON.
+
+`jax.profiler` traces show the DEVICE timeline; what it cannot show is
+where the HOST spent its time between dispatches — data loading, batch
+sharding, loss readback, checkpoint writes, rendezvous.  `SpanRecorder`
+captures those as Chrome-trace "complete" events (``ph: "X"``) that
+load in ``chrome://tracing`` / https://ui.perfetto.dev next to the
+device trace.
+
+Correlation contract: every span carries ``args.step`` (the global step
+id) when the caller provides one, and the trainers run `jax.profiler`
+device traces with the SAME step ids (`jax.profiler.StepTraceAnnotation`
+naming convention) — load both files in perfetto and match on step.
+
+Opt-in via ``TPU_DIST_TELEMETRY=<dir>``: `from_env` records to
+``<dir>/spans_rank<r>.trace.json`` (saved on `save`, which the trainers
+call at fit-exit).  Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+from tpu_dist.observe import events as _events
+
+
+class SpanRecorder:
+    """Collects Chrome-trace events in memory; `save` writes the JSON
+    object format (``{"traceEvents": [...]}``).  Thread-safe."""
+
+    enabled = True
+
+    # Memory bound for multi-day runs: ~3 spans/step accumulate in
+    # memory until save(); past this cap new spans are counted, not
+    # stored (the count lands in the saved file's otherData).
+    MAX_EVENTS = 200_000
+
+    def __init__(self, path: str | None = None, rank: int = 0,
+                 max_events: int | None = None):
+        self.path = path
+        self.rank = int(rank)
+        self.max_events = self.MAX_EVENTS if max_events is None else max_events
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._trace_events: list[dict] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, step: int | None = None, **args):
+        """Time a host-side region.  ``step`` is the device-trace
+        correlation key; extra kwargs land in the event's ``args``."""
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter() - t0
+            self._append(
+                {
+                    "name": name,
+                    "ph": "X",
+                    "ts": wall0 * 1e6,  # microseconds, trace convention
+                    "dur": dur * 1e6,
+                    "pid": self.rank,
+                    "tid": threading.get_ident() & 0xFFFFFF,
+                    "args": self._args(step, args),
+                }
+            )
+
+    def instant(self, name: str, step: int | None = None, **args) -> None:
+        """A zero-duration marker (preemption signal, chaos injection)."""
+        self._append(
+            {
+                "name": name,
+                "ph": "i",
+                "s": "p",  # process-scoped instant
+                "ts": time.time() * 1e6,
+                "pid": self.rank,
+                "tid": threading.get_ident() & 0xFFFFFF,
+                "args": self._args(step, args),
+            }
+        )
+
+    @staticmethod
+    def _args(step, args) -> dict:
+        out = dict(args)
+        if step is not None:
+            out["step"] = int(step)
+        return out
+
+    def _append(self, ev: dict) -> None:
+        with self._lock:
+            if len(self._trace_events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._trace_events.append(ev)
+
+    def __len__(self) -> int:
+        return len(self._trace_events)
+
+    def save(self, path: str | None = None) -> str | None:
+        """Write the Chrome-trace JSON; returns the path (None if this
+        recorder has nowhere to write).  Idempotent — call at every
+        fit-exit; later spans simply extend the file on the next save."""
+        path = path or self.path
+        if path is None:
+            return None
+        with self._lock:
+            doc = {
+                "traceEvents": list(self._trace_events),
+                "displayTimeUnit": "ms",
+                "otherData": {
+                    "producer": "tpu_dist.observe.spans",
+                    "rank": self.rank,
+                    "dropped_events": self.dropped,
+                },
+            }
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+        return path
+
+
+class NullRecorder:
+    """Telemetry-off stand-in (same surface, zero cost)."""
+
+    enabled = False
+    path = None
+
+    @contextlib.contextmanager
+    def span(self, name, step=None, **args):
+        yield
+
+    def instant(self, name, step=None, **args):
+        pass
+
+    def save(self, path=None):
+        return None
+
+    def __len__(self):
+        return 0
+
+
+NULL = NullRecorder()
+_cache: dict[tuple[str, int], SpanRecorder] = {}
+_cache_lock = threading.Lock()
+
+
+def from_env(rank: int | None = None):
+    """This process's recorder under ``TPU_DIST_TELEMETRY`` (cached per
+    dir+rank), or the NULL recorder when telemetry is off."""
+    dirpath = os.environ.get(_events.ENV_DIR)
+    if not dirpath:
+        return NULL
+    r = _events.env_rank(rank)
+    key = (dirpath, r)
+    with _cache_lock:
+        rec = _cache.get(key)
+        if rec is None:
+            os.makedirs(dirpath, exist_ok=True)
+            rec = SpanRecorder(
+                os.path.join(dirpath, f"spans_rank{r}.trace.json"), rank=r
+            )
+            _cache[key] = rec
+        return rec

@@ -139,6 +139,18 @@ def rendezvous_attempt(attempt: int) -> None:
         )
 
 
+def _emit_chaos_event(clause: str, rank: int) -> None:
+    """Record an injection into the structured event log (no-op when
+    ``TPU_DIST_TELEMETRY`` is unset): a chaos run's events file shows
+    WHAT was injected next to what the resilience layer did about it."""
+    try:
+        from tpu_dist.observe import events as ev_mod
+
+        ev_mod.from_env(rank=rank).emit("chaos", clause=clause)
+    except Exception:
+        pass  # injection must proceed even if telemetry is broken
+
+
 def at_launch(rank: int) -> None:
     """Launch-time injection for one child rank: sleep (``delay=``) or
     hard-exit (``kill=``, scoped to `launch_attempt`).  Called by
@@ -149,11 +161,14 @@ def at_launch(rank: int) -> None:
     if rank in spec.delay:
         import time
 
+        _emit_chaos_event(f"delay={rank}:{spec.delay[rank]}", rank)
         time.sleep(spec.delay[rank])
     if spec.kill.get(rank) == launch_attempt():
         # A hard exit, not an exception: the parent must observe a child
         # that died without reporting — the failure mode the supervisor
-        # detects via pipe EOF.
+        # detects via pipe EOF.  The event line is flushed on emit, so it
+        # survives the _exit.
+        _emit_chaos_event(f"kill={rank}@{launch_attempt()}", rank)
         os._exit(17)
 
 

@@ -31,6 +31,28 @@ from typing import Any, Callable
 logger = logging.getLogger("tpu_dist.resilience")
 
 
+def _emit_retry_event(
+    describe: str, attempt: int, policy: "RetryPolicy",
+    error: BaseException, backoff_s: float,
+) -> None:
+    """Mirror one retry/backoff into the structured event log (no-op
+    when ``TPU_DIST_TELEMETRY`` is unset) — the ``log`` line above keeps
+    the human-readable surface, this keeps the machine-parseable one."""
+    try:
+        from tpu_dist.observe import events as ev_mod
+
+        ev_mod.from_env().emit(
+            "retry",
+            what=describe,
+            attempt=attempt + 1,
+            max_attempts=policy.max_attempts,
+            error=f"{type(error).__name__}: {error}",
+            backoff_s=round(backoff_s, 3),
+        )
+    except Exception:
+        pass  # telemetry must never turn a retried failure into a fatal one
+
+
 class RendezvousTimeout(RuntimeError):
     """Bootstrap rendezvous / distributed init did not succeed within the
     retry budget or startup deadline."""
@@ -134,6 +156,7 @@ def retry_call(
                 f"{describe}: attempt {attempt + 1}/{policy.max_attempts} "
                 f"failed ({type(e).__name__}: {e}); backing off {d:.2f}s"
             )
+            _emit_retry_event(describe, attempt, policy, e, d)
             sleep(d)
     assert last is not None
     if error_type is not None:

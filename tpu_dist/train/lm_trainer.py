@@ -381,13 +381,41 @@ class LMTrainer:
                 f"use more data"
             )
         steps_per_epoch = n // gb
-        history = []
-        from tpu_dist.resilience.preempt import PreemptionGuard
-        from tpu_dist.train import checkpoint as ckpt_mod
         from tpu_dist.train import metrics as metrics_mod
         from tpu_dist.train.checkpoint import AsyncCheckpointer
 
         writer = AsyncCheckpointer() if checkpoint_dir else None
+        # Opt-in telemetry (TPU_DIST_TELEMETRY): manifest + per-step JSONL
+        # events, heartbeat, host spans, goodput — see docs/observability.md.
+        telemetry = metrics_mod.TrainTelemetry(
+            world=self.world, mesh=self.mesh, config=cfg, trainer="LMTrainer"
+        )
+        ok = False
+        try:
+            history = self._fit_loop(
+                cfg, windows, n, s, gb, steps_per_epoch, epochs, start_epoch,
+                val_windows, checkpoint_dir, writer, telemetry,
+            )
+            if writer is not None:
+                writer.wait()
+            ok = True
+            return history
+        finally:
+            # Always runs — a fit that raises must still flush the span
+            # trace and mark this rank's heartbeat (crashed, not silent).
+            telemetry.finish(ok=ok)
+
+    def _fit_loop(
+        self, cfg, windows, n, s, gb, steps_per_epoch, epochs, start_epoch,
+        val_windows, checkpoint_dir, writer, telemetry,
+    ) -> list[LMEpochStats]:
+        """The epoch/step loop of `fit` (split out so fit can wrap it in
+        the telemetry try/finally)."""
+        from tpu_dist.resilience.preempt import PreemptionGuard
+        from tpu_dist.train import checkpoint as ckpt_mod
+        from tpu_dist.train import metrics as metrics_mod
+
+        history = []
         with PreemptionGuard() as preempt:
             for epoch in range(
                 start_epoch, epochs if epochs is not None else cfg.epochs
@@ -398,24 +426,43 @@ class LMTrainer:
                 total, steps_done = 0.0, 0
                 for b in range(steps_per_epoch):
                     idx = order[b * gb : (b + 1) * gb]
-                    batch = parallel.shard_batch(
-                        (jnp.asarray(windows[idx]),), self.mesh,
-                        spec=self._batch_spec,
-                    )
+                    with telemetry.spans.span(
+                        "data_next", step=telemetry.global_step + 1
+                    ):
+                        batch = parallel.shard_batch(
+                            (jnp.asarray(windows[idx]),), self.mesh,
+                            spec=self._batch_spec,
+                        )
                     key = jax.random.fold_in(
                         jax.random.fold_in(jax.random.key(cfg.seed + 1), epoch), b
                     )
-                    self.params, self._model_state, self.opt_state, loss, _ = (
-                        self.step(
-                            self.params, self._model_state, self.opt_state,
-                            batch, key,
-                        )
+                    (
+                        self.params,
+                        self._model_state,
+                        self.opt_state,
+                        loss_f,
+                    ) = telemetry.run_step(
+                        self.step,
+                        (self.params, self._model_state, self.opt_state,
+                         batch, key),
+                        epoch=epoch,
+                        batch_size=gb,
+                        nan_guard=cfg.nan_guard,
+                        extra=lambda step_s: {
+                            "tokens_per_sec_per_chip": round(
+                                gb * s / step_s / self.world, 3
+                            ),
+                        },
                     )
-                    total += float(loss)
+                    total += loss_f
                     steps_done += 1
                     if preempt.requested:
                         break
                 if preempt.requested:
+                    telemetry.preempted(
+                        signal=preempt.signal_name, epoch=epoch,
+                        step=steps_done,
+                    )
                     # Step boundary after SIGTERM/SIGINT: one synchronous
                     # checkpoint recording the CURRENT (incomplete) epoch
                     # — restore() hands it back as the resume epoch — then
@@ -426,16 +473,16 @@ class LMTrainer:
                         tree = {
                             "params": self.params, "opt_state": self.opt_state
                         }
-                        if self._sharded_mode:
-                            ckpt_mod.save_sharded(
-                                f"{checkpoint_dir}/lm_ckpt_preempt", tree,
-                                step=epoch,
-                            )
-                        else:
-                            ckpt_mod.save(
-                                f"{checkpoint_dir}/lm_ckpt_preempt.npz", tree,
-                                step=epoch,
-                            )
+                        with telemetry.goodput.measure("checkpoint") as ck:
+                            if self._sharded_mode:
+                                path = f"{checkpoint_dir}/lm_ckpt_preempt"
+                                ckpt_mod.save_sharded(path, tree, step=epoch)
+                            else:
+                                path = f"{checkpoint_dir}/lm_ckpt_preempt.npz"
+                                ckpt_mod.save(path, tree, step=epoch)
+                        telemetry.checkpoint_done(
+                            path=path, epoch=epoch, seconds=ck.seconds,
+                        )
                     cfg.log(
                         f"preemption ({preempt.signal_name}) at epoch "
                         f"{epoch} step {steps_done}: "
@@ -451,11 +498,12 @@ class LMTrainer:
                 tps = steps_per_epoch * gb * s / dt
                 vloss = vppl = None
                 if val_windows is not None:
-                    host = jax.tree.map(np.asarray, self._full_params())
-                    vloss, vppl = lm_perplexity(
-                        self.lm, host, np.asarray(val_windows),
-                        batch=min(64, len(val_windows)),
-                    )
+                    with telemetry.goodput.measure("eval"):
+                        host = jax.tree.map(np.asarray, self._full_params())
+                        vloss, vppl = lm_perplexity(
+                            self.lm, host, np.asarray(val_windows),
+                            batch=min(64, len(val_windows)),
+                        )
                 bad = (
                     metrics_mod.bad_steps(self.opt_state)
                     if cfg.nan_guard
@@ -469,22 +517,25 @@ class LMTrainer:
                 history.append(
                     LMEpochStats(epoch, mean, dt, tps, vloss, vppl, bad)
                 )
+                telemetry.epoch_done(
+                    epoch=epoch, mean_loss=mean, seconds=dt,
+                    tokens_per_sec=round(tps, 3), val_loss=vloss,
+                    val_perplexity=vppl, bad_steps=bad,
+                )
                 if checkpoint_dir:
                     tree = {"params": self.params, "opt_state": self.opt_state}
-                    if self._sharded_mode:
-                        # sharded format = a DIRECTORY of shard files — no
-                        # .npz suffix (ADVICE r2: a dir named .npz misleads)
-                        writer.save_sharded(
-                            f"{checkpoint_dir}/lm_ckpt_{epoch}", tree,
-                            step=epoch + 1,
-                        )
-                    else:
-                        writer.save(
-                            f"{checkpoint_dir}/lm_ckpt_{epoch}.npz", tree,
-                            step=epoch + 1,
-                        )
-        if writer is not None:
-            writer.wait()
+                    with telemetry.goodput.measure("checkpoint") as ck:
+                        if self._sharded_mode:
+                            # sharded format = a DIRECTORY of shard files — no
+                            # .npz suffix (ADVICE r2: a dir named .npz misleads)
+                            path = f"{checkpoint_dir}/lm_ckpt_{epoch}"
+                            writer.save_sharded(path, tree, step=epoch + 1)
+                        else:
+                            path = f"{checkpoint_dir}/lm_ckpt_{epoch}.npz"
+                            writer.save(path, tree, step=epoch + 1)
+                    telemetry.checkpoint_done(
+                        path=path, epoch=epoch, seconds=ck.seconds,
+                    )
         return history
 
     def restore(self, path) -> int:

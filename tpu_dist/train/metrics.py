@@ -40,7 +40,9 @@ class StepTimer:
         return sum(self.times) / max(len(self.times), 1)
 
     def samples_per_sec(self, batch: int) -> float:
-        return batch / self.mean if self.times else 0.0
+        # NaN, not 0.0, when no steps were recorded: a silent zero reads
+        # as "measured: infinitely slow" and poisons averages downstream.
+        return batch / self.mean if self.times else float("nan")
 
 
 def allreduce_gbps(nbytes: int, seconds: float, world: int) -> float:
@@ -76,8 +78,6 @@ def device_memory_stats(device=None) -> dict | None:
     ``peak_bytes_in_use``, ``bytes_limit``, ...) or None where the
     backend doesn't track them (CPU-sim).  The `watch nvidia-smi` analog
     (tuto.md:381), pulled from the runtime instead of a side tool."""
-    import jax
-
     dev = device or jax.devices()[0]
     stats = getattr(dev, "memory_stats", lambda: None)()
     return dict(stats) if stats else None
@@ -99,6 +99,195 @@ def loss_scale(opt_state) -> float | None:
     from tpu_dist.resilience import guards
 
     return guards.loss_scale(opt_state)
+
+
+class TrainTelemetry:
+    """Per-fit observability bundle shared by `Trainer` and `LMTrainer`:
+    the JSONL event log, per-rank heartbeat, host-side span tracing,
+    goodput accounting, and the process metrics registry
+    (`tpu_dist.observe`) behind one call surface.
+
+    Opt-in: with ``TPU_DIST_TELEMETRY`` unset, every call is a cheap
+    no-op (registry updates excepted — those are in-memory and only
+    exported when ``TPU_DIST_METRICS_PORT`` is set).  Constructing one
+    emits the run manifest (config/mesh/platform provenance)."""
+
+    def __init__(self, *, world: int, mesh, config, trainer: str):
+        from tpu_dist import observe
+
+        self.events = observe.events.from_env()
+        self.enabled = self.events.enabled
+        self.heartbeat = observe.heartbeat.from_env() if self.enabled else None
+        self.spans = observe.spans.from_env()
+        self.goodput = observe.heartbeat.GoodputMeter()
+        observe.registry.maybe_serve_from_env()
+        reg = observe.registry.REGISTRY
+        self._steps_c = reg.counter(
+            "tpu_dist_steps_total", "optimizer steps taken"
+        )
+        self._loss_g = reg.gauge("tpu_dist_loss", "last training-step loss")
+        self._step_h = reg.histogram(
+            "tpu_dist_step_seconds", "train step wall time (seconds)"
+        )
+        self._bad_g = reg.gauge(
+            "tpu_dist_bad_steps", "cumulative NaN-guard skipped steps"
+        )
+        self._every = observe.events.step_every()
+        self.world = world
+        self.global_step = 0
+        self._compiled = False
+        self._flops: float | None = None
+        self._flops_captured = False
+        if self.enabled:
+            self.events.manifest(
+                world=world, config=config, mesh=mesh, trainer=trainer
+            )
+
+    def capture_step_flops(self, step_fn, step_args: tuple) -> None:
+        """XLA-measured FLOPs of one compiled step, for per-step MFU.
+        Call BEFORE the first step executes (donated buffers are dead
+        after it).  Only works when ``step_fn`` is a `jax.jit` object
+        (has ``.lower``); costs one extra AOT compile, so it only runs
+        when telemetry is on."""
+        if self._flops_captured or not self.enabled:
+            return
+        self._flops_captured = True
+        if not hasattr(step_fn, "lower"):
+            return
+        from tpu_dist.train import flops as flops_mod
+
+        self._flops = flops_mod.xla_flops(step_fn, *step_args)
+
+    def run_step(
+        self,
+        step_fn,
+        args: tuple,
+        *,
+        epoch: int,
+        batch_size: int,
+        nan_guard: bool = False,
+        extra=None,
+    ):
+        """Execute one training step under the full instrumentation
+        choreography — FLOPs capture (first call), ``dispatch`` and
+        ``readback`` spans sharing the step id the step event gets, and
+        `step_done` — in ONE place for both trainers (the perfetto
+        correlation recipe depends on these span names/ids staying in
+        lockstep with the event stream).
+
+        ``args`` is the step's ``(params, model_state, opt_state, batch,
+        key)``; ``extra`` is an optional ``step_seconds -> dict`` of
+        additional step-event fields (e.g. tokens/s).  Returns
+        ``(params, model_state, opt_state, loss_float)``."""
+        self.capture_step_flops(step_fn, args)
+        sid = self.global_step + 1
+        st0 = time.perf_counter()
+        with self.spans.span("dispatch", step=sid):
+            params, model_state, opt_state, loss, _ = step_fn(*args)
+        with self.spans.span("readback", step=sid):
+            loss_f = float(loss)
+        step_s = time.perf_counter() - st0
+        self.step_done(
+            epoch=epoch,
+            loss=loss_f,
+            step_seconds=step_s,
+            batch_size=batch_size,
+            opt_state=opt_state,
+            nan_guard=nan_guard,
+            **(extra(step_s) if extra is not None else {}),
+        )
+        return params, model_state, opt_state, loss_f
+
+    def step_done(
+        self,
+        *,
+        epoch: int,
+        loss: float,
+        step_seconds: float,
+        batch_size: int,
+        opt_state=None,
+        nan_guard: bool = False,
+        **extra,
+    ) -> None:
+        """Record one completed optimizer step (the first one of a fit is
+        accounted as compile time, not productive time)."""
+        self.goodput.account(
+            "productive" if self._compiled else "compile", step_seconds
+        )
+        self._compiled = True
+        self.global_step += 1
+        self._steps_c.inc()
+        self._loss_g.set(loss)
+        self._step_h.observe(step_seconds)
+        if self.heartbeat is not None:
+            self.heartbeat.beat(step=self.global_step, phase="train")
+        if not self.enabled or self.global_step % self._every:
+            return
+        from tpu_dist.train import flops as flops_mod
+
+        bad = bad_steps(opt_state) if nan_guard else None
+        scale = loss_scale(opt_state) if nan_guard else None
+        if bad is not None:
+            self._bad_g.set(bad)
+        self.events.emit(
+            "step",
+            step=self.global_step,
+            epoch=epoch,
+            loss=loss,
+            step_time=round(step_seconds, 6),
+            samples_per_sec_per_chip=round(
+                batch_size / step_seconds / self.world, 3
+            ),
+            mfu=flops_mod.mfu(self._flops, step_seconds),
+            bad_steps=bad,
+            loss_scale=scale,
+            hbm=device_memory_stats(),
+            **extra,
+        )
+
+    def epoch_done(self, *, epoch: int, mean_loss: float, seconds: float,
+                   **extra) -> None:
+        if self.enabled:
+            self.events.emit(
+                "epoch",
+                epoch=epoch,
+                mean_loss=mean_loss,
+                seconds=round(seconds, 4),
+                goodput=self.goodput.summary(),
+                **extra,
+            )
+
+    def checkpoint_done(self, *, path, epoch: int, seconds: float) -> None:
+        if self.enabled:
+            self.events.emit(
+                "checkpoint",
+                path=str(path),
+                epoch=epoch,
+                seconds=round(seconds, 4),
+            )
+
+    def preempted(self, *, signal: str, epoch: int, step: int) -> None:
+        if self.enabled:
+            self.spans.instant("preempt", step=self.global_step)
+            self.events.emit(
+                "preempt", signal=signal, epoch=epoch, step=step
+            )
+
+    def finish(self, ok: bool = True) -> None:
+        """Fit-exit (call from a finally): flush the span trace, close
+        this rank's heartbeat — ``done`` on a clean exit (a finished rank
+        must not read as stalled), ``crashed`` when the fit raised (a
+        dead rank must STAY attributable to peers' watchdogs).  Never
+        raises: telemetry teardown must not mask the fit's exception."""
+        try:
+            self.spans.save()
+        except Exception:
+            pass
+        try:
+            if self.heartbeat is not None:
+                self.heartbeat.close(phase="done" if ok else "crashed")
+        except Exception:
+            pass
 
 
 def compiled_memory_analysis(fn, *args) -> dict | None:

@@ -323,13 +323,41 @@ class Trainer:
                 f"({loader.local_batch}) — zero steps per epoch; shrink the "
                 f"batch, the world size, or use more data"
             )
-        history = []
         step_key = jax.random.key(cfg.seed + 1)
-        from tpu_dist.resilience.preempt import PreemptionGuard
         from tpu_dist.train.checkpoint import AsyncCheckpointer
 
         ckpt_writer = AsyncCheckpointer() if checkpoint_dir is not None else None
         suffix = "" if self._sharded_mode else ".npz"
+        # Opt-in telemetry (TPU_DIST_TELEMETRY): manifest + per-step JSONL
+        # events, heartbeat, host spans, goodput — see docs/observability.md.
+        telemetry = metrics_mod.TrainTelemetry(
+            world=self.world, mesh=self.mesh, config=cfg, trainer="Trainer"
+        )
+        ok = False
+        try:
+            history = self._fit_loop(
+                cfg, loader, epochs, start_epoch, checkpoint_dir, trace_dir,
+                eval_dataset, step_key, ckpt_writer, suffix, telemetry,
+            )
+            if ckpt_writer is not None:
+                ckpt_writer.wait()
+            ok = True
+            return history
+        finally:
+            # Always runs — a fit that raises must still flush the span
+            # trace and mark this rank's heartbeat (crashed, not silent).
+            telemetry.finish(ok=ok)
+
+    def _fit_loop(
+        self, cfg, loader, epochs, start_epoch, checkpoint_dir, trace_dir,
+        eval_dataset, step_key, ckpt_writer, suffix, telemetry,
+    ) -> list[EpochStats]:
+        """The epoch/step loop of `fit` (split out so fit can wrap it in
+        the telemetry try/finally)."""
+        from tpu_dist.resilience.preempt import PreemptionGuard
+        from tpu_dist.train import metrics as metrics_mod
+
+        history = []
         with PreemptionGuard() as preempt:
             for epoch in range(
                 start_epoch, epochs if epochs is not None else cfg.epochs
@@ -337,11 +365,17 @@ class Trainer:
                 t0 = time.perf_counter()
                 total_loss, num_batches = 0.0, 0
                 with metrics_mod.trace(trace_dir if epoch == start_epoch else None):
-                    batches = prefetch_to_mesh(
+                    batches = iter(prefetch_to_mesh(
                         loader.epoch(epoch), self.mesh,
                         axis_name=self.mesh.axis_names[0],
-                    )
-                    for bi, batch in enumerate(batches):
+                    ))
+                    for bi in range(loader.steps_per_epoch):
+                        with telemetry.spans.span(
+                            "data_next", step=telemetry.global_step + 1
+                        ):
+                            batch = next(batches, None)
+                        if batch is None:
+                            break
                         # fold epoch and batch index separately: no collisions
                         # however many steps an epoch has
                         key = jax.random.fold_in(
@@ -351,16 +385,24 @@ class Trainer:
                             self.params,
                             self.model_state,
                             self.opt_state,
-                            loss,
-                            _,
-                        ) = self.step(
-                            self.params, self.model_state, self.opt_state, batch, key
+                            loss_f,
+                        ) = telemetry.run_step(
+                            self.step,
+                            (self.params, self.model_state, self.opt_state,
+                             batch, key),
+                            epoch=epoch,
+                            batch_size=cfg.global_batch,
+                            nan_guard=cfg.nan_guard,
                         )
-                        total_loss += float(loss)
+                        total_loss += loss_f
                         num_batches += 1
                         if preempt.requested:
                             break
                 if preempt.requested:
+                    telemetry.preempted(
+                        signal=preempt.signal_name, epoch=epoch,
+                        step=num_batches,
+                    )
                     # Step boundary after SIGTERM/SIGINT: write one
                     # synchronous checkpoint for the CURRENT (incomplete)
                     # epoch — restore() returns this epoch, so resume
@@ -368,9 +410,11 @@ class Trainer:
                     if checkpoint_dir is not None:
                         if ckpt_writer is not None:
                             ckpt_writer.wait()
-                        self.save(
-                            f"{checkpoint_dir}/ckpt_preempt{suffix}",
-                            epoch=epoch,
+                        path = f"{checkpoint_dir}/ckpt_preempt{suffix}"
+                        with telemetry.goodput.measure("checkpoint") as ck:
+                            self.save(path, epoch=epoch)
+                        telemetry.checkpoint_done(
+                            path=path, epoch=epoch, seconds=ck.seconds,
                         )
                     cfg.log(
                         f"preemption ({preempt.signal_name}) at epoch "
@@ -389,7 +433,8 @@ class Trainer:
                 # (identical) ranks.
                 acc = None
                 if eval_dataset is not None:
-                    acc = self.evaluate(eval_dataset)
+                    with telemetry.goodput.measure("eval"):
+                        acc = self.evaluate(eval_dataset)
                 bad = (
                     metrics_mod.bad_steps(self.opt_state)
                     if cfg.nan_guard
@@ -402,13 +447,18 @@ class Trainer:
                     + (f"  bad_steps {bad}" if bad else "")
                 )
                 history.append(EpochStats(epoch, mean_loss, dt, sps, acc, bad))
+                telemetry.epoch_done(
+                    epoch=epoch, mean_loss=mean_loss, seconds=dt,
+                    samples_per_sec=round(sps, 3), eval_accuracy=acc,
+                    bad_steps=bad,
+                )
                 if checkpoint_dir is not None:
-                    self.save(
-                        f"{checkpoint_dir}/ckpt_{epoch}{suffix}", epoch=epoch + 1,
-                        async_writer=ckpt_writer,
+                    path = f"{checkpoint_dir}/ckpt_{epoch}{suffix}"
+                    with telemetry.goodput.measure("checkpoint") as ck:
+                        self.save(path, epoch=epoch + 1, async_writer=ckpt_writer)
+                    telemetry.checkpoint_done(
+                        path=path, epoch=epoch, seconds=ck.seconds,
                     )
-        if ckpt_writer is not None:
-            ckpt_writer.wait()
         return history
 
     def evaluate(self, dataset, *, batch_size: int = 1024) -> float:

@@ -17,6 +17,7 @@ caller after the step.
 from __future__ import annotations
 
 import contextlib
+import os
 import sys
 import threading
 
@@ -24,19 +25,36 @@ import jax
 
 
 @contextlib.contextmanager
-def collective_watchdog(timeout_s: float = 120.0, what: str = "device program"):
+def collective_watchdog(
+    timeout_s: float = 120.0,
+    what: str = "device program",
+    *,
+    telemetry_dir: str | None = None,
+    expected_world: int | None = None,
+):
     """Context manager that screams (stderr) if the wrapped block doesn't
     finish within ``timeout_s`` — likely a stalled collective (missing
     peer process, mismatched collective order across hosts, or a dead
     interconnect link).  The block is NOT killed (XLA offers no safe
     cancel); the message tells the operator what to look at, turning an
-    indefinite silent hang into a diagnosed one."""
+    indefinite silent hang into a diagnosed one.
+
+    When telemetry is on (``TPU_DIST_TELEMETRY``, or an explicit
+    ``telemetry_dir``) the scream is upgraded from "something stalled"
+    to ATTRIBUTED: per-rank heartbeats are aggregated and the message —
+    and a machine-parseable ``stall`` event in the JSONL log — names
+    which rank is how many seconds behind (``expected_world`` also
+    reports ranks that never heartbeat at all)."""
     fired = threading.Event()
     done = threading.Event()
 
     def watch():
         if not done.wait(timeout_s):
             fired.set()
+            # The core scream FIRST, unconditionally: the telemetry path
+            # below touches the filesystem (heartbeat dir, event log) and
+            # a wedged mount mid-incident must not be able to silence the
+            # watchdog's one job.
             print(
                 f"[tpu_dist watchdog] '{what}' has not completed after "
                 f"{timeout_s:.0f}s — likely a stalled collective. Check: "
@@ -47,6 +65,37 @@ def collective_watchdog(timeout_s: float = 120.0, what: str = "device program"):
                 file=sys.stderr,
                 flush=True,
             )
+            try:
+                from tpu_dist.observe import events as ev_mod
+                from tpu_dist.observe import heartbeat as hb_mod
+
+                hb_dir = telemetry_dir or os.environ.get(ev_mod.ENV_DIR)
+                if not hb_dir:
+                    return
+                # Half the watchdog budget as the staleness bound: a rank
+                # quiet that long while the block overran is the
+                # straggler, not timing jitter.
+                ranks_behind = hb_mod.attribute_stall(
+                    hb_dir,
+                    stale_after_s=timeout_s / 2,
+                    expected_world=expected_world,
+                )
+                print(
+                    f"[tpu_dist watchdog] attribution: "
+                    f"{hb_mod.describe_stall(ranks_behind)}",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                # An explicit telemetry_dir must receive the stall event
+                # even when TPU_DIST_TELEMETRY is unset.
+                ev_mod.for_dir(hb_dir).emit(
+                    "stall",
+                    what=what,
+                    timeout_s=timeout_s,
+                    ranks_behind=ranks_behind,
+                )
+            except Exception:
+                pass  # telemetry must never break the watchdog
 
     t = threading.Thread(target=watch, daemon=True)
     t.start()
@@ -54,6 +103,7 @@ def collective_watchdog(timeout_s: float = 120.0, what: str = "device program"):
         yield fired
     finally:
         done.set()
+        t.join(timeout=1.0)
 
 
 def blocked_until_ready(tree, *, timeout_s: float = 120.0, what: str = "step"):
