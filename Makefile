@@ -9,7 +9,7 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 .PHONY: test chaos ptp gather allreduce train bench runtime train-image \
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
-        chaos-resume docs demos telemetry-demo
+        chaos-resume docs demos telemetry-demo bench-dispatch
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -49,6 +49,9 @@ longcontext:
 
 bench:
 	$(PY) bench.py
+
+bench-dispatch:  # sync vs K-deep pipelined dispatch on the parity workload
+	$(PY) benchmarks/dispatch.py --platform $(PLATFORM)
 
 runtime:
 	$(MAKE) -C tpu_dist/runtime

@@ -30,6 +30,58 @@ class TestInitConfig:
         assert cfg.num_processes is None
         assert cfg.process_id is None
 
+    def test_compile_cache_env_wires_jax_and_emits_telemetry(
+        self, monkeypatch, tmp_path
+    ):
+        """TPU_DIST_COMPILE_CACHE=<dir> via init(): jax persists compiled
+        programs there, and a second compile of the same program is a
+        cache HIT surfaced as a compile_cache event."""
+        import importlib
+        import os
+
+        init_mod = importlib.import_module("tpu_dist.comm.init")
+        from tpu_dist.observe import events
+
+        cache_dir = tmp_path / "xla_cache"
+        tdir = tmp_path / "telemetry"
+        monkeypatch.setenv(init_mod.ENV_COMPILE_CACHE, str(cache_dir))
+        monkeypatch.setenv(events.ENV_DIR, str(tdir))
+        monkeypatch.delenv(events.ENV_RUN_ID, raising=False)
+        monkeypatch.setattr(init_mod, "_compile_cache_dir", None)
+        prev_entry = jax.config.jax_persistent_cache_min_entry_size_bytes
+        prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            assert init_mod._setup_compile_cache() == str(cache_dir)
+            # two distinct jit objects over the same program: the second
+            # compile must be served from the persistent cache
+            jax.jit(lambda x: x * 3 + 1)(jnp.ones(8)).block_until_ready()
+            assert any(
+                n.endswith("-cache") for n in os.listdir(cache_dir)
+            ), "no compiled program persisted"
+            jax.jit(lambda x: x * 3 + 1)(jnp.ones(8)).block_until_ready()
+            recs = events.read_events(str(tdir))
+            outcomes = {
+                r["outcome"] for r in recs if r["event"] == "compile_cache"
+            }
+            assert {"hit", "miss"} <= outcomes
+            n, errors = events.validate_dir(str(tdir))
+            assert errors == []
+        finally:
+            # Full de-pollution: cache off, thresholds restored, the
+            # memoized tmp-dir cache dropped, and the hit/miss listener
+            # unregistered so later tests' event files stay clean.
+            jax.config.update("jax_compilation_cache_dir", None)
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", prev_entry
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_secs
+            )
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()  # drop the memoized tmp-dir cache
+            jax.monitoring.clear_event_listeners()
+
     def test_file_init_rejects_multihost_master_addr(self, monkeypatch, tmp_path):
         # file:// rendezvous publishes a loopback coordinator, so an
         # off-host MASTER_ADDR signals a job it cannot serve: fail at

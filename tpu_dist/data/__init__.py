@@ -2,7 +2,12 @@
 
 from tpu_dist.data.cifar import load_cifar10, synthetic_cifar10, synthetic_images
 from tpu_dist.data.digits import load_real_digits
-from tpu_dist.data.loader import DistributedLoader, Loader, prefetch_to_mesh
+from tpu_dist.data.loader import (
+    DistributedLoader,
+    HostLoader,
+    Loader,
+    prefetch_to_mesh,
+)
 from tpu_dist.data.mnist import (
     Dataset,
     load_idx_images,
@@ -17,6 +22,7 @@ __all__ = [
     "DataPartitioner",
     "Dataset",
     "DistributedLoader",
+    "HostLoader",
     "Loader",
     "Partition",
     "TEXT_VOCAB",

@@ -156,3 +156,123 @@ def prefetch_to_mesh(iterator, mesh, *, depth: int = 2, axis_name: str = "data")
         except StopIteration:
             pass
         yield out
+
+
+class _WorkerFailure:
+    """Queue marker carrying the worker thread's exception."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+_END = object()  # queue marker: the wrapped iterator is exhausted
+
+
+class HostLoader:
+    """Background host loader: numpy batch assembly AND the sharded
+    ``device_put`` run on a daemon thread, feeding a bounded queue.
+
+    `prefetch_to_mesh` overlaps the H2D *transfer* with compute, but the
+    host-side work — pulling the next batch from the wrapped iterator
+    (shuffle indexing, np.concatenate) and issuing the device_put — still
+    runs on the training loop's thread, between two dispatches.  Under
+    the pipelined driver that host slice is the only thing left on the
+    critical path, so `HostLoader` moves it off: the worker stays
+    ``depth`` batches ahead, and the loop's ``next()`` is a queue pop.
+
+    Semantics are identical to iterating the wrapped iterator through
+    `shard_batch` inline: one worker + a FIFO queue preserve order and
+    content exactly (the determinism invariant, SURVEY.md §2c.6).  A
+    worker exception is re-raised in the consumer — never a hang — and
+    `close` (or the ``with`` exit, covering early breaks on preemption)
+    always unblocks and joins the thread."""
+
+    def __init__(
+        self,
+        iterator: Iterator,
+        mesh,
+        *,
+        depth: int = 2,
+        axis_name: str = "data",
+        spec=None,
+    ):
+        if depth < 1:
+            raise ValueError(f"HostLoader depth must be >= 1, got {depth}")
+        import queue as queue_mod
+        import threading
+
+        from tpu_dist.parallel.data_parallel import shard_batch
+
+        self._queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=depth)
+        self._Empty = queue_mod.Empty
+        self._Full = queue_mod.Full
+        self._stop = threading.Event()
+        self._done = False
+
+        def work():
+            try:
+                for item in iterator:
+                    placed = shard_batch(item, mesh, axis_name, spec=spec)
+                    if not self._put(placed):
+                        return  # closed mid-epoch: drop the batch, exit
+                self._put(_END)
+            except BaseException as e:  # noqa: BLE001 — must reach consumer
+                self._put(_WorkerFailure(e))
+
+        self._thread = threading.Thread(
+            target=work, name="tpu-dist-host-loader", daemon=True
+        )
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when `close` raised the stop flag
+        (the consumer is gone — blocking forever would leak the thread)."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except self._Full:
+                continue
+        return False
+
+    def __iter__(self) -> "HostLoader":
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                item = self._queue.get(timeout=0.5)
+                break
+            except self._Empty:
+                if not self._thread.is_alive() and self._queue.empty():
+                    # worker gone without an end marker (should be
+                    # impossible — it posts _END or _WorkerFailure)
+                    self._done = True
+                    raise StopIteration from None
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _WorkerFailure):
+            self._done = True
+            raise item.error
+        return item
+
+    def close(self) -> None:
+        """Shut the worker down (idempotent): raise the stop flag, drain
+        the queue so a blocked put wakes, and join."""
+        self._stop.set()
+        self._done = True
+        while True:
+            try:
+                self._queue.get_nowait()
+            except self._Empty:
+                break
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "HostLoader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -71,6 +71,7 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "spmd_result": ("spmd_rank", "summary"),
     "bench": ("metric", "value"),
     "heartbeat": ("step",),
+    "compile_cache": ("outcome",),  # "hit" | "miss" (comm.init cache)
 }
 
 

@@ -210,15 +210,32 @@ class GoodputMeter:
     Categories are free-form strings; the conventional ones are
     ``productive`` (timed train steps), ``compile`` (first-step tracing/
     compilation), ``checkpoint``, ``restart``, ``eval``.  ``goodput`` =
-    productive / total accounted time."""
+    productive / total accounted time.
+
+    Phases are a second, overlapping axis: under step pipelining the
+    productive interval of step N contains a host ``dispatch`` slice and
+    (K steps later) a ``readback`` slice.  `account_phase` tracks those
+    WITHOUT entering the category total — they decompose productive
+    time, they don't compete with it — and `summary` reports them under
+    ``phases`` so an operator can see how much of the loop the host
+    spent dispatching vs blocked on results."""
 
     PRODUCTIVE = "productive"
 
     def __init__(self):
         self.seconds: dict[str, float] = {}
+        self.phase_seconds: dict[str, float] = {}
 
     def account(self, category: str, seconds: float) -> None:
         self.seconds[category] = self.seconds.get(category, 0.0) + float(seconds)
+
+    def account_phase(self, phase: str, seconds: float) -> None:
+        """Host-phase accounting (``dispatch`` / ``readback``): kept OUT
+        of the category total — phases overlap the productive intervals
+        they decompose, so adding them would double-count wall time."""
+        self.phase_seconds[phase] = (
+            self.phase_seconds.get(phase, 0.0) + float(seconds)
+        )
 
     @contextlib.contextmanager
     def measure(self, category: str):
@@ -243,6 +260,9 @@ class GoodputMeter:
         g = self.goodput()
         return {
             "seconds": {k: round(v, 4) for k, v in sorted(self.seconds.items())},
+            "phases": {
+                k: round(v, 4) for k, v in sorted(self.phase_seconds.items())
+            },
             "total_s": round(self.total(), 4),
             "goodput": round(g, 4) if g is not None else None,
         }

@@ -13,11 +13,14 @@ from tpu_dist.train.optim import (
     sgd,
     with_ema,
 )
+from tpu_dist.train.pipeline_driver import CompletedStep, PipelineDriver
 from tpu_dist.train.trainer import EpochStats, TrainConfig, Trainer
 from tpu_dist.train.lm_trainer import LMEpochStats, LMTrainConfig, LMTrainer
 
 __all__ = [
+    "CompletedStep",
     "EpochStats",
+    "PipelineDriver",
     "LMEpochStats",
     "LMTrainConfig",
     "LMTrainer",

@@ -101,6 +101,11 @@ class LMTrainConfig:
     # skip-and-count without scaling.
     nan_guard: bool = False
     loss_scale: float | None = None
+    # Step-pipeline depth (see train.pipeline_driver): up to this many
+    # dispatched-but-unread steps in flight; 0 = synchronous loop.
+    # Drained at every observable boundary, so epoch stats / bad_steps /
+    # checkpoints are depth-invariant.
+    inflight_steps: int = 2
     log: Callable[[str], None] = print
 
 
@@ -411,12 +416,17 @@ class LMTrainer:
     ) -> list[LMEpochStats]:
         """The epoch/step loop of `fit` (split out so fit can wrap it in
         the telemetry try/finally)."""
+        from tpu_dist.data.loader import HostLoader
         from tpu_dist.resilience.preempt import PreemptionGuard
         from tpu_dist.train import checkpoint as ckpt_mod
         from tpu_dist.train import metrics as metrics_mod
+        from tpu_dist.train.pipeline_driver import PipelineDriver
 
         history = []
-        with PreemptionGuard() as preempt:
+        # `with`: a fit that raises mid-epoch still drains the ring, so
+        # already-dispatched steps keep their readbacks/telemetry.
+        with PipelineDriver(telemetry, depth=cfg.inflight_steps) as driver, \
+                PreemptionGuard() as preempt:
             for epoch in range(
                 start_epoch, epochs if epochs is not None else cfg.epochs
             ):
@@ -424,40 +434,58 @@ class LMTrainer:
                 order = rng.permutation(n)
                 t0 = time.perf_counter()
                 total, steps_done = 0.0, 0
-                for b in range(steps_per_epoch):
-                    idx = order[b * gb : (b + 1) * gb]
-                    with telemetry.spans.span(
-                        "data_next", step=telemetry.global_step + 1
-                    ):
-                        batch = parallel.shard_batch(
-                            (jnp.asarray(windows[idx]),), self.mesh,
-                            spec=self._batch_spec,
+
+                def host_batches(order=order):
+                    for b in range(steps_per_epoch):
+                        yield (windows[order[b * gb : (b + 1) * gb]],)
+
+                # Background host loader: the fancy-index window gather +
+                # sharded device_put run off the critical path, feeding
+                # the in-flight ring.
+                with HostLoader(
+                    host_batches(), self.mesh, spec=self._batch_spec
+                ) as batches:
+                    for b in range(steps_per_epoch):
+                        with telemetry.spans.span(
+                            "data_next", step=telemetry.next_step_id
+                        ):
+                            batch = next(batches, None)
+                        if batch is None:
+                            break
+                        key = jax.random.fold_in(
+                            jax.random.fold_in(
+                                jax.random.key(cfg.seed + 1), epoch
+                            ), b
                         )
-                    key = jax.random.fold_in(
-                        jax.random.fold_in(jax.random.key(cfg.seed + 1), epoch), b
-                    )
-                    (
-                        self.params,
-                        self._model_state,
-                        self.opt_state,
-                        loss_f,
-                    ) = telemetry.run_step(
-                        self.step,
-                        (self.params, self._model_state, self.opt_state,
-                         batch, key),
-                        epoch=epoch,
-                        batch_size=gb,
-                        nan_guard=cfg.nan_guard,
-                        extra=lambda step_s: {
-                            "tokens_per_sec_per_chip": round(
-                                gb * s / step_s / self.world, 3
-                            ),
-                        },
-                    )
-                    total += loss_f
+                        (
+                            self.params,
+                            self._model_state,
+                            self.opt_state,
+                            completed,
+                        ) = driver.step(
+                            self.step,
+                            (self.params, self._model_state, self.opt_state,
+                             batch, key),
+                            epoch=epoch,
+                            batch_size=gb,
+                            nan_guard=cfg.nan_guard,
+                            extra=lambda step_s: {
+                                "tokens_per_sec_per_chip": round(
+                                    gb * s / step_s / self.world, 3
+                                ),
+                            },
+                        )
+                        for c in completed:
+                            total += c.loss
+                            steps_done += 1
+                        if preempt.requested:
+                            break
+                # Observable boundary: every dispatched step's loss lands
+                # in this epoch's mean before eval/checkpoint/preempt
+                # touch the state.
+                for c in driver.drain():
+                    total += c.loss
                     steps_done += 1
-                    if preempt.requested:
-                        break
                 if preempt.requested:
                     telemetry.preempted(
                         signal=preempt.signal_name, epoch=epoch,

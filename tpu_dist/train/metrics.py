@@ -19,7 +19,14 @@ import jax
 @dataclass
 class StepTimer:
     """Wall-clock step timer with warmup discard (first steps include
-    compilation)."""
+    compilation).
+
+    Two usage modes: as a context manager around a blocking step
+    (enter/exit wall time), or via `tick` in a pipelined loop, where
+    steps are dispatched without waiting and the meaningful per-step
+    wall time is DISPATCH-TO-DISPATCH — the interval between successive
+    `tick` calls (at steady state the device is the bottleneck, so the
+    dispatch period equals the device step time)."""
 
     warmup: int = 2
     times: list = field(default_factory=list)
@@ -34,6 +41,18 @@ class StepTimer:
         self._count += 1
         if self._count > self.warmup:
             self.times.append(time.perf_counter() - self._t0)
+
+    def tick(self) -> None:
+        """Record one dispatch boundary: the interval since the previous
+        `tick` is a step time (the first call only arms the timer, and
+        the first ``warmup`` intervals are discarded like the context
+        manager's)."""
+        now = time.perf_counter()
+        if self._t0:
+            self._count += 1
+            if self._count > self.warmup:
+                self.times.append(now - self._t0)
+        self._t0 = now
 
     @property
     def mean(self) -> float:
@@ -135,6 +154,8 @@ class TrainTelemetry:
         self._every = observe.events.step_every()
         self.world = world
         self.global_step = 0
+        self._dispatched = 0
+        self._pending_tail = None
         self._compiled = False
         self._flops: float | None = None
         self._flops_captured = False
@@ -142,6 +163,15 @@ class TrainTelemetry:
             self.events.manifest(
                 world=world, config=config, mesh=mesh, trainer=trainer
             )
+
+    @property
+    def next_step_id(self) -> int:
+        """Step id the NEXT dispatch will be assigned — the span
+        correlation key for host phases that precede a dispatch (e.g.
+        ``data_next``).  Under pipelining ``global_step`` (readbacks)
+        lags dispatches by the ring depth, so spans must key off the
+        dispatch counter, not the readback counter."""
+        return self._dispatched + 1
 
     def capture_step_flops(self, step_fn, step_args: tuple) -> None:
         """XLA-measured FLOPs of one compiled step, for per-step MFU.
@@ -158,6 +188,108 @@ class TrainTelemetry:
 
         self._flops = flops_mod.xla_flops(step_fn, *step_args)
 
+    def dispatch_step(
+        self,
+        step_fn,
+        args: tuple,
+        *,
+        epoch: int,
+        index: int = 0,
+        batch_size: int,
+        nan_guard: bool = False,
+        extra=None,
+    ):
+        """Dispatch one training step WITHOUT waiting for its result —
+        the pipelined half of the instrumentation choreography: FLOPs
+        capture (first call), step-id assignment, the ``dispatch`` span,
+        dispatch-phase goodput/heartbeat, and (when this step will emit
+        an event) async device-side copies of the NaN-guard scalars,
+        taken now because the opt-state leaves they live in are donated
+        into the next dispatch.
+
+        ``args`` is the step's ``(params, model_state, opt_state, batch,
+        key)``.  Returns ``(outputs, pending)`` where ``outputs`` is the
+        step's raw 5-tuple and ``pending`` is the
+        `pipeline_driver.PendingStep` to hand to `complete_step` later
+        (`PipelineDriver` does both)."""
+        from tpu_dist.train.pipeline_driver import PendingStep
+
+        self.capture_step_flops(step_fn, args)
+        self._dispatched += 1
+        sid = self._dispatched
+        t0 = time.perf_counter()
+        # dispatch-to-dispatch wall time: this dispatch closes the
+        # previous step's interval (the pipelined loop's step time)
+        prev = self._pending_tail
+        if prev is not None and prev.d2d_seconds is None:
+            prev.d2d_seconds = t0 - prev.t_dispatch
+        with self.spans.span("dispatch", step=sid):
+            out = step_fn(*args)
+        dispatch_s = time.perf_counter() - t0
+        self.goodput.account_phase("dispatch", dispatch_s)
+        if self.heartbeat is not None:
+            # The ONE per-step beat (same file-write cadence as the
+            # synchronous loop had): dispatch is the timely progress
+            # signal under pipelining — a wedged device blocks the next
+            # readback, which blocks the next dispatch, so the beat
+            # still goes stale within K steps of a stall.
+            self.heartbeat.beat(step=sid, phase="dispatch")
+        emit = self.enabled and sid % self._every == 0
+        bad_ref = scale_ref = None
+        if emit and nan_guard:
+            from tpu_dist.resilience.guards import _guard_state
+
+            g = _guard_state(out[2])
+            if g is not None:
+                # `x + 0` is an async device-side copy: a NEW buffer the
+                # next dispatch's donation cannot invalidate, and reading
+                # it back later syncs only through THIS step.
+                bad_ref = g["bad_steps"] + 0
+                scale_ref = g["scale"] + 0
+        pending = PendingStep(
+            step_id=sid, epoch=epoch, index=index, loss=out[3],
+            batch_size=batch_size, nan_guard=nan_guard, t_dispatch=t0,
+            dispatch_seconds=dispatch_s, bad_ref=bad_ref,
+            scale_ref=scale_ref, extra=extra, emit=emit,
+        )
+        self._pending_tail = pending
+        return out, pending
+
+    def complete_step(self, pending) -> float:
+        """Read back one pending step's results and emit its telemetry —
+        the ``readback`` span and the step event carry the step id
+        assigned at DISPATCH time, so the event stream and the perfetto
+        correlation recipe are unchanged by pipelining.  Returns the loss
+        as a float."""
+        sid = pending.step_id
+        t0 = time.perf_counter()
+        with self.spans.span("readback", step=sid):
+            loss_f = float(pending.loss)
+        self.goodput.account_phase("readback", time.perf_counter() - t0)
+        # Per-step wall time: dispatch-to-dispatch where a next dispatch
+        # exists; dispatch-to-completion for the last steps of a drain.
+        step_s = (
+            pending.d2d_seconds
+            if pending.d2d_seconds is not None
+            else time.perf_counter() - pending.t_dispatch
+        )
+        bad = int(pending.bad_ref) if pending.bad_ref is not None else None
+        scale = (
+            float(pending.scale_ref) if pending.scale_ref is not None else None
+        )
+        self.step_done(
+            epoch=pending.epoch,
+            loss=loss_f,
+            step_seconds=step_s,
+            batch_size=pending.batch_size,
+            nan_guard=pending.nan_guard,
+            step=sid,
+            bad=bad,
+            scale=scale,
+            **(pending.extra(step_s) if pending.extra is not None else {}),
+        )
+        return loss_f
+
     def run_step(
         self,
         step_fn,
@@ -168,35 +300,17 @@ class TrainTelemetry:
         nan_guard: bool = False,
         extra=None,
     ):
-        """Execute one training step under the full instrumentation
-        choreography — FLOPs capture (first call), ``dispatch`` and
-        ``readback`` spans sharing the step id the step event gets, and
-        `step_done` — in ONE place for both trainers (the perfetto
-        correlation recipe depends on these span names/ids staying in
-        lockstep with the event stream).
-
-        ``args`` is the step's ``(params, model_state, opt_state, batch,
-        key)``; ``extra`` is an optional ``step_seconds -> dict`` of
-        additional step-event fields (e.g. tokens/s).  Returns
-        ``(params, model_state, opt_state, loss_float)``."""
-        self.capture_step_flops(step_fn, args)
-        sid = self.global_step + 1
-        st0 = time.perf_counter()
-        with self.spans.span("dispatch", step=sid):
-            params, model_state, opt_state, loss, _ = step_fn(*args)
-        with self.spans.span("readback", step=sid):
-            loss_f = float(loss)
-        step_s = time.perf_counter() - st0
-        self.step_done(
-            epoch=epoch,
-            loss=loss_f,
-            step_seconds=step_s,
-            batch_size=batch_size,
-            opt_state=opt_state,
-            nan_guard=nan_guard,
-            **(extra(step_s) if extra is not None else {}),
+        """Execute one training step SYNCHRONOUSLY (dispatch + immediate
+        readback) — the depth-0 composition of `dispatch_step` /
+        `complete_step`, kept for callers that want the blocking
+        contract.  Returns ``(params, model_state, opt_state,
+        loss_float)``."""
+        out, pending = self.dispatch_step(
+            step_fn, args, epoch=epoch, batch_size=batch_size,
+            nan_guard=nan_guard, extra=extra,
         )
-        return params, model_state, opt_state, loss_f
+        loss_f = self.complete_step(pending)
+        return out[0], out[1], out[2], loss_f
 
     def step_done(
         self,
@@ -207,31 +321,38 @@ class TrainTelemetry:
         batch_size: int,
         opt_state=None,
         nan_guard: bool = False,
+        step: int | None = None,
+        bad: int | None = None,
+        scale: float | None = None,
         **extra,
     ) -> None:
         """Record one completed optimizer step (the first one of a fit is
-        accounted as compile time, not productive time)."""
+        accounted as compile time, not productive time).  ``step``
+        defaults to the readback counter; pipelined callers pass the
+        dispatch-assigned id.  ``bad``/``scale`` short-circuit the
+        opt-state readback when the guard scalars were already captured
+        at dispatch time."""
         self.goodput.account(
             "productive" if self._compiled else "compile", step_seconds
         )
         self._compiled = True
         self.global_step += 1
+        sid = step if step is not None else self.global_step
         self._steps_c.inc()
         self._loss_g.set(loss)
         self._step_h.observe(step_seconds)
-        if self.heartbeat is not None:
-            self.heartbeat.beat(step=self.global_step, phase="train")
-        if not self.enabled or self.global_step % self._every:
+        if not self.enabled or sid % self._every:
             return
         from tpu_dist.train import flops as flops_mod
 
-        bad = bad_steps(opt_state) if nan_guard else None
-        scale = loss_scale(opt_state) if nan_guard else None
+        if nan_guard and bad is None:
+            bad = bad_steps(opt_state)
+            scale = loss_scale(opt_state)
         if bad is not None:
             self._bad_g.set(bad)
         self.events.emit(
             "step",
-            step=self.global_step,
+            step=sid,
             epoch=epoch,
             loss=loss,
             step_time=round(step_seconds, 6),

@@ -1,0 +1,168 @@
+"""Step pipelining — K-deep dispatch with deferred metrics readback.
+
+The fused train step made the DEVICE side of a step one program, but the
+host loop around it re-introduced a serializer: reading the loss back
+(``float(loss)``) after every dispatch makes the host wait for step N's
+device result before dispatching step N+1, so XLA's async dispatch and
+the input-pipeline prefetch buy nothing — on the latency-bound parity
+workload the host round-trip IS the step time.
+
+`PipelineDriver` decouples dispatch from result consumption with a
+bounded in-flight ring of depth K (``TrainConfig.inflight_steps``): the
+trainer dispatches step N immediately and only reads back loss/metrics
+for step N−K.  Correctness needs no per-step host decision — the NaN
+guard skips non-finite steps *on device* (`resilience.guards.nan_guard`)
+— so the only places the host must resynchronize are the observable
+boundaries: epoch end, eval, checkpoint, preemption.  `drain` is that
+explicit barrier, and because readbacks happen in FIFO dispatch order,
+the drained loop produces bit-identical observable results (epoch mean
+loss, bad_steps, checkpointed state) to the synchronous loop.
+
+Depth semantics: ``depth=K`` keeps up to K dispatched-but-unread steps
+in flight (the readback of step N−K happens right after dispatch of
+step N).  ``depth=0`` is the synchronous loop — dispatch then immediate
+readback — so both trainers run ONE code path and the sync/async choice
+is pure config.
+
+The driver is telemetry-aware but telemetry-optional: with a
+`train.metrics.TrainTelemetry` it runs the full instrumentation
+choreography (``dispatch`` spans at dispatch time, ``readback`` spans +
+step events at readback time, with the step ids assigned at dispatch);
+with ``telemetry=None`` (benchmarks) it only moves losses.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class PendingStep:
+    """One dispatched-but-unread step in the ring.
+
+    ``loss`` is the step's device scalar (a step OUTPUT — never donated
+    into the next dispatch, so holding it is safe); ``bad_ref`` /
+    ``scale_ref`` are async device-side COPIES of the NaN-guard scalars
+    (the originals are opt-state leaves, dead the moment the next
+    dispatch donates them).  ``d2d_seconds`` — dispatch-to-dispatch wall
+    time, the pipelined loop's per-step time — is filled in by the NEXT
+    dispatch; it stays None for the last steps of a drain, where
+    dispatch-to-completion is reported instead."""
+
+    step_id: int
+    epoch: int
+    index: int  # 0-based dispatch index, fit-global
+    loss: Any
+    batch_size: int
+    nan_guard: bool = False
+    t_dispatch: float = 0.0
+    dispatch_seconds: float = 0.0
+    d2d_seconds: float | None = None
+    bad_ref: Any = None
+    scale_ref: Any = None
+    extra: Callable[[float], dict] | None = None
+    emit: bool = False
+
+
+@dataclass(frozen=True)
+class CompletedStep:
+    """A read-back step: what the training loop accumulates."""
+
+    step_id: int
+    epoch: int
+    index: int  # 0-based dispatch index, fit-global
+    loss: float
+
+
+class PipelineDriver:
+    """Bounded in-flight ring between a training loop and its compiled
+    step.  See the module docstring for semantics; the step function
+    contract is the trainers' 5-tuple ``step(params, model_state,
+    opt_state, batch, key) -> (params, model_state, opt_state, loss,
+    aux)``."""
+
+    def __init__(self, telemetry=None, *, depth: int = 2):
+        if depth < 0:
+            raise ValueError(
+                f"inflight depth must be >= 0 (0 = synchronous), got {depth}"
+            )
+        self.telemetry = telemetry
+        self.depth = int(depth)
+        self._ring: collections.deque[PendingStep] = collections.deque()
+        self._dispatched = 0
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._ring)
+
+    def step(
+        self,
+        step_fn: Callable,
+        args: tuple,
+        *,
+        epoch: int = 0,
+        batch_size: int = 0,
+        nan_guard: bool = False,
+        extra: Callable[[float], dict] | None = None,
+    ) -> tuple[Any, Any, Any, list[CompletedStep]]:
+        """Dispatch one step and read back whatever the depth bound
+        evicts.  Returns ``(params, model_state, opt_state, completed)``
+        — ``completed`` holds 0 or more `CompletedStep` in dispatch
+        order (older steps whose results are now consumed)."""
+        index = self._dispatched
+        self._dispatched += 1
+        if self.telemetry is not None:
+            out, pending = self.telemetry.dispatch_step(
+                step_fn, args,
+                epoch=epoch, index=index, batch_size=batch_size,
+                nan_guard=nan_guard, extra=extra,
+            )
+        else:
+            t0 = time.perf_counter()
+            out = step_fn(*args)
+            pending = PendingStep(
+                step_id=self._dispatched, epoch=epoch, index=index,
+                loss=out[3], batch_size=batch_size, t_dispatch=t0,
+                dispatch_seconds=time.perf_counter() - t0,
+            )
+        params, model_state, opt_state = out[0], out[1], out[2]
+        self._ring.append(pending)
+        completed = []
+        while len(self._ring) > self.depth:
+            completed.append(self._complete(self._ring.popleft()))
+        return params, model_state, opt_state, completed
+
+    def drain(self) -> list[CompletedStep]:
+        """Read back EVERYTHING in flight — the explicit host/device
+        barrier for observable boundaries (epoch end, eval, checkpoint,
+        preemption).  After `drain` the host has every dispatched step's
+        loss and the device queue is empty."""
+        completed = []
+        while self._ring:
+            completed.append(self._complete(self._ring.popleft()))
+        return completed
+
+    def _complete(self, pending: PendingStep) -> CompletedStep:
+        if self.telemetry is not None:
+            loss_f = self.telemetry.complete_step(pending)
+        else:
+            loss_f = float(pending.loss)
+        return CompletedStep(
+            pending.step_id, pending.epoch, pending.index, loss_f
+        )
+
+    # drain-on-exit so a raising fit never leaves device work unobserved
+    def __enter__(self) -> "PipelineDriver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        try:
+            self.drain()
+        except Exception:
+            # the primary exception (if any) must win; a failed readback
+            # of an abandoned step is secondary
+            if exc == (None, None, None):
+                raise

@@ -27,8 +27,9 @@ import numpy as np
 from jax.sharding import Mesh
 
 from tpu_dist import nn, parallel
-from tpu_dist.data.loader import DistributedLoader, prefetch_to_mesh
+from tpu_dist.data.loader import DistributedLoader, HostLoader, prefetch_to_mesh
 from tpu_dist.train.optim import Optimizer, sgd
+from tpu_dist.train.pipeline_driver import PipelineDriver
 
 
 @dataclass
@@ -74,6 +75,15 @@ class TrainConfig:
     # (escalating backoff on overflow); replicated-DP mode only.
     nan_guard: bool = False
     loss_scale: float | None = None
+    # Step-pipeline depth: up to this many dispatched-but-unread steps
+    # in flight (loss/metrics for step N are read back after dispatching
+    # step N+K), so the host never stands between two device steps.  0 =
+    # the synchronous loop (read back every step immediately).  The
+    # driver drains at every observable boundary (epoch end, eval,
+    # checkpoint, preemption), so epoch stats, bad_steps, and
+    # checkpointed state are bit-identical whatever the depth
+    # (tests/test_pipeline_driver.py).
+    inflight_steps: int = 2
 
 
 @dataclass
@@ -358,46 +368,61 @@ class Trainer:
         from tpu_dist.train import metrics as metrics_mod
 
         history = []
-        with PreemptionGuard() as preempt:
+        # `with`: a fit that raises mid-epoch still drains the ring, so
+        # already-dispatched steps keep their readbacks/telemetry.
+        with PipelineDriver(telemetry, depth=cfg.inflight_steps) as driver, \
+                PreemptionGuard() as preempt:
             for epoch in range(
                 start_epoch, epochs if epochs is not None else cfg.epochs
             ):
                 t0 = time.perf_counter()
                 total_loss, num_batches = 0.0, 0
                 with metrics_mod.trace(trace_dir if epoch == start_epoch else None):
-                    batches = iter(prefetch_to_mesh(
+                    # Background host loader: batch assembly + sharded
+                    # device_put off the critical path, feeding the ring
+                    # (the `with` joins the worker even on an early
+                    # preemption break).
+                    with HostLoader(
                         loader.epoch(epoch), self.mesh,
                         axis_name=self.mesh.axis_names[0],
-                    ))
-                    for bi in range(loader.steps_per_epoch):
-                        with telemetry.spans.span(
-                            "data_next", step=telemetry.global_step + 1
-                        ):
-                            batch = next(batches, None)
-                        if batch is None:
-                            break
-                        # fold epoch and batch index separately: no collisions
-                        # however many steps an epoch has
-                        key = jax.random.fold_in(
-                            jax.random.fold_in(step_key, epoch), bi
-                        )
-                        (
-                            self.params,
-                            self.model_state,
-                            self.opt_state,
-                            loss_f,
-                        ) = telemetry.run_step(
-                            self.step,
-                            (self.params, self.model_state, self.opt_state,
-                             batch, key),
-                            epoch=epoch,
-                            batch_size=cfg.global_batch,
-                            nan_guard=cfg.nan_guard,
-                        )
-                        total_loss += loss_f
+                    ) as batches:
+                        for bi in range(loader.steps_per_epoch):
+                            with telemetry.spans.span(
+                                "data_next", step=telemetry.next_step_id
+                            ):
+                                batch = next(batches, None)
+                            if batch is None:
+                                break
+                            # fold epoch and batch index separately: no
+                            # collisions however many steps an epoch has
+                            key = jax.random.fold_in(
+                                jax.random.fold_in(step_key, epoch), bi
+                            )
+                            (
+                                self.params,
+                                self.model_state,
+                                self.opt_state,
+                                completed,
+                            ) = driver.step(
+                                self.step,
+                                (self.params, self.model_state,
+                                 self.opt_state, batch, key),
+                                epoch=epoch,
+                                batch_size=cfg.global_batch,
+                                nan_guard=cfg.nan_guard,
+                            )
+                            for c in completed:
+                                total_loss += c.loss
+                                num_batches += 1
+                            if preempt.requested:
+                                break
+                    # Epoch boundary (also the eval/checkpoint/preempt
+                    # boundary): drain the ring so every dispatched step's
+                    # loss is in this epoch's mean and the device queue is
+                    # empty before any state is observed.
+                    for c in driver.drain():
+                        total_loss += c.loss
                         num_batches += 1
-                        if preempt.requested:
-                            break
                 if preempt.requested:
                     telemetry.preempted(
                         signal=preempt.signal_name, epoch=epoch,
@@ -474,25 +499,35 @@ class Trainer:
         # Round the batch to a multiple of the mesh size (sharding needs
         # equal pieces), never below it.
         batch_size = max(self.world, min(batch_size, n) // self.world * self.world)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        sharded = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
         eval_params = self.params
         if self.config.fsdp:  # reassemble once for the whole eval pass
             eval_params = parallel.fsdp_full_params(
                 self.params, self._param_template, self.mesh,
                 parallel.DATA_AXIS,  # the axis make_fsdp_train_step sharded over
             )
+        # Eval batches ride the same prefetch pipeline as training: the
+        # pad/stack assembly and H2D transfer for batch i+1 overlap the
+        # compiled apply of batch i (labels stay on the host — only the
+        # pixels travel).
+        starts = list(range(0, n, batch_size))
+
+        def host_batches():
+            for i in starts:
+                xs = dataset.images[i : i + batch_size]
+                if len(xs) < batch_size:
+                    pad = batch_size - len(xs)
+                    xs = np.concatenate(
+                        [xs, np.zeros((pad,) + xs.shape[1:], xs.dtype)]
+                    )
+                yield (xs,)
+
         correct = 0
-        for i in range(0, n, batch_size):
-            xs = dataset.images[i : i + batch_size]
+        prefetched = prefetch_to_mesh(
+            host_batches(), self.mesh, axis_name=self.mesh.axis_names[0]
+        )
+        for i, (xs,) in zip(starts, prefetched):
             ys = dataset.labels[i : i + batch_size]
-            valid = len(ys)
-            if valid < batch_size:
-                pad = batch_size - valid
-                xs = np.concatenate([xs, np.zeros((pad,) + xs.shape[1:], xs.dtype)])
-            xs = jax.device_put(jnp.asarray(xs), sharded)
             scores = self._eval_apply(eval_params, self.model_state, xs)
-            pred = np.asarray(scores).argmax(-1)[:valid]
+            pred = np.asarray(scores).argmax(-1)[: len(ys)]
             correct += int((pred == ys).sum())
         return correct / n
