@@ -9,7 +9,7 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 .PHONY: test chaos ptp gather allreduce train bench runtime train-image \
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
-        chaos-resume docs demos telemetry-demo bench-dispatch
+        chaos-resume docs demos telemetry-demo bench-dispatch bench-compress
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -52,6 +52,9 @@ bench:
 
 bench-dispatch:  # sync vs K-deep pipelined dispatch on the parity workload
 	$(PY) benchmarks/dispatch.py --platform $(PLATFORM)
+
+bench-compress:  # gradient-sync backends + bucket-size sweep (bytes-on-wire, GB/s)
+	$(PY) benchmarks/grad_reduce.py --platform $(PLATFORM) --world $(WORLD) --bucket-sweep
 
 runtime:
 	$(MAKE) -C tpu_dist/runtime

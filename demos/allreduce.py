@@ -92,11 +92,38 @@ def bench(world, platform, mbytes: float, iters: int):
     return results
 
 
+def run_compressed(wire: str, mbytes: float):
+    """The compressed-sync exercise: one bucketed quantized allreduce
+    (`comm.compress`) vs the exact psum on the same per-rank payload —
+    prints bytes-on-wire vs fp32 and the max abs error, mirroring the
+    tutorial's ring exercise with a lossy wire."""
+    from tpu_dist import comm
+    from tpu_dist.comm import compress as compress_mod
+
+    cfg = compress_mod.parse(wire)  # validates the wire dtype up front
+
+    def fn():
+        import jax
+        from jax import lax
+
+        n = int(mbytes * 1e6 / 4)
+        x = jax.random.normal(jax.random.key(0), (n,)) * (comm.rank() + 1.0)
+        exact = comm.all_reduce(x)
+        approx = comm.compressed_all_reduce(x, cfg)
+        err = jnp.max(jnp.abs(approx - exact))
+        scale = jnp.max(jnp.abs(exact))
+        return err, scale, lax.axis_size(comm.DEFAULT_AXIS) * jnp.ones(())
+
+    return cfg, fn
+
+
 def main():
     args = parse_args(
         default_world=4,
         bench=(int, 0, "run the bandwidth benchmark with this many iters"),
         mbytes=(float, 16.0, "payload size in MB for --bench"),
+        compress=(str, "", "compressed-allreduce demo wire dtype "
+                           "(int8 | fp8 | float8_e5m2 | bf16)"),
     )
     from tpu_dist import comm
 
@@ -109,6 +136,23 @@ def main():
             f"Rank {r} after 4 rounds: psum={float(vb[r]):.0f} "
             f"ring={float(vr[r]):.0f} (expect {world}^4={world**4}), "
             f"max|psum-ring|={float(diff[r]):.2e}"
+        )
+    if args.compress:
+        from tpu_dist.comm import compress as compress_mod
+
+        cfg, fn = run_compressed(args.compress, args.mbytes)
+        err, scale, ws = comm.spmd(fn, world=args.world, platform=args.platform)
+        w = int(float(ws[0]))
+        plan = compress_mod.FlatPlan(
+            jnp.zeros((int(args.mbytes * 1e6 / 4),)), w, cfg
+        )
+        wire_b, exact_b = plan.bytes_on_wire(), plan.bytes_exact()
+        print(
+            f"compressed allreduce ({cfg.wire}, {plan.n_buckets} buckets): "
+            f"{wire_b/1e6:.2f} MB on wire vs {exact_b/1e6:.2f} MB fp32 "
+            f"({exact_b/max(wire_b,1):.1f}x less), "
+            f"max|err| {float(err[0]):.3e} "
+            f"({float(err[0])/max(float(scale[0]),1e-30):.2%} of max|sum|)"
         )
     if args.bench:
         bench(args.world, args.platform, args.mbytes, args.bench)

@@ -25,6 +25,8 @@ from tpu_dist.comm.collectives import (
     shift,
     world_size,
 )
+from tpu_dist.comm import compress
+from tpu_dist.comm.compress import CompressConfig, compressed_all_reduce
 from tpu_dist.comm.launch import launch
 from tpu_dist.comm.init import (
     InitConfig,
@@ -37,6 +39,7 @@ from tpu_dist.comm.runner import spmd
 
 __all__ = [
     "DEFAULT_AXIS",
+    "CompressConfig",
     "Group",
     "InitConfig",
     "ReduceOp",
@@ -46,6 +49,8 @@ __all__ = [
     "all_to_all",
     "barrier",
     "broadcast",
+    "compress",
+    "compressed_all_reduce",
     "devices",
     "gather",
     "init",

@@ -389,26 +389,44 @@ def all_to_all(
 
 
 _WIRE_DTYPES = {
-    # name -> (jnp dtype, max representable magnitude)
+    # name -> (jnp dtype, max representable magnitude; None = scale-free
+    # wire, the cast itself is the codec)
     "int8": ("int8", 127.0),
     "float8_e4m3": ("float8_e4m3fn", 448.0),
     "float8_e5m2": ("float8_e5m2", 57344.0),
+    "bfloat16": ("bfloat16", None),
+}
+
+# Short spellings accepted wherever a wire dtype is named (configs, env
+# vars, CLI flags) — one table shared with `comm.compress`.
+WIRE_ALIASES = {
+    "int8": "int8",
+    "fp8": "float8_e4m3",
+    "fp8_e4m3": "float8_e4m3",
+    "float8_e4m3": "float8_e4m3",
+    "fp8_e5m2": "float8_e5m2",
+    "float8_e5m2": "float8_e5m2",
+    "bf16": "bfloat16",
+    "bfloat16": "bfloat16",
 }
 
 
 def _wire_spec(dtype: str):
-    if dtype not in _WIRE_DTYPES:
+    canon = WIRE_ALIASES.get(str(dtype).lower())
+    if canon is None or canon not in _WIRE_DTYPES:
         raise ValueError(
-            f"unknown wire dtype {dtype!r}; one of {list(_WIRE_DTYPES)}"
+            f"unknown wire dtype {dtype!r}; one of {sorted(set(WIRE_ALIASES))}"
         )
-    name, maxv = _WIRE_DTYPES[dtype]
+    name, maxv = _WIRE_DTYPES[canon]
     return jnp.dtype(name), maxv
 
 
 def _quantize_wire(x: jax.Array, dtype: str) -> tuple[jax.Array, jax.Array]:
     wire, maxv = _wire_spec(dtype)
+    if maxv is None:  # scale-free wire (bf16): the cast rounds
+        return x.astype(wire), jnp.ones((), jnp.float32)
     scale = jnp.max(jnp.abs(x)) / maxv + 1e-30
-    if dtype == "int8":
+    if wire == jnp.dtype("int8"):
         q = jnp.clip(jnp.round(x / scale), -maxv, maxv).astype(wire)
     else:  # fp8: the cast itself rounds; clip guards the saturating edge
         q = jnp.clip(x / scale, -maxv, maxv).astype(wire)
@@ -431,8 +449,10 @@ def all_reduce_quantized(
     ``dtype`` picks the wire format: ``"int8"`` (uniform grid over the
     chunk scale — best when magnitudes are homogeneous),
     ``"float8_e4m3"`` (relative precision over ~±448·scale — better for
-    heavy-tailed gradients, the MXU-native fp8), or ``"float8_e5m2"``
-    (wider range, coarser mantissa).  All ship 1 byte/element.
+    heavy-tailed gradients, the MXU-native fp8), ``"float8_e5m2"``
+    (wider range, coarser mantissa) — all 1 byte/element — or
+    ``"bfloat16"`` (scale-free cast, 2 bytes/element, ~2x less wire than
+    f32 with bf16-mantissa accuracy).
 
     Structure mirrors the bandwidth-optimal allreduce: a quantized
     REDUCE-SCATTER (all_to_all of int8 chunks + per-chunk scales; each
@@ -453,13 +473,17 @@ def all_reduce_quantized(
     wire, maxv = _wire_spec(dtype)
     n = lax.axis_size(axis_name)
     chunks = pad_to_multiple(x.reshape(-1), n).reshape(n, -1)  # chunk c -> rank c
-    # Per-chunk symmetric quantization (one scale per destination chunk).
-    scales = jnp.max(jnp.abs(chunks), axis=1) / maxv + 1e-30
-    scaled = chunks / scales[:, None]
-    if dtype == "int8":
-        q = jnp.clip(jnp.round(scaled), -maxv, maxv).astype(wire)
+    if maxv is None:  # scale-free wire (bf16): unit scales, the cast rounds
+        scales = jnp.ones((n,), jnp.float32)
+        q = chunks.astype(wire)
     else:
-        q = jnp.clip(scaled, -maxv, maxv).astype(wire)
+        # Per-chunk symmetric quantization (one scale per destination chunk).
+        scales = jnp.max(jnp.abs(chunks), axis=1) / maxv + 1e-30
+        scaled = chunks / scales[:, None]
+        if wire == jnp.dtype("int8"):
+            q = jnp.clip(jnp.round(scaled), -maxv, maxv).astype(wire)
+        else:
+            q = jnp.clip(scaled, -maxv, maxv).astype(wire)
     # Quantized reduce-scatter: rank r receives every rank's chunk r.
     q_in = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
     s_in = lax.all_to_all(

@@ -72,6 +72,8 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "bench": ("metric", "value"),
     "heartbeat": ("step",),
     "compile_cache": ("outcome",),  # "hit" | "miss" (comm.init cache)
+    # compressed gradient sync (comm.compress): per-epoch wire accounting
+    "compress": ("wire", "bytes_on_wire", "bytes_saved", "compression_error"),
 }
 
 

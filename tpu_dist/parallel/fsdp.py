@@ -70,6 +70,44 @@ def _reduce_scatter_grads(grads: Any, n: int, axis_name: str) -> Any:
     )
 
 
+def _compress_setup(grad_compress, grad_pmean_axes, builder: str):
+    """Parse/validate the compressed-reduce-scatter config for a ZeRO
+    builder (config-parse time, not trace time)."""
+    from tpu_dist.comm import compress as compress_mod
+
+    ccfg = compress_mod.parse(grad_compress)
+    if ccfg is not None and grad_pmean_axes:
+        raise ValueError(
+            f"{builder}: grad_compress supports the pure data-axis "
+            "reduce-scatter only; grad_pmean_axes (TP composition) is "
+            "not compressed"
+        )
+    return ccfg, ccfg is not None and ccfg.error_feedback
+
+
+def _compressed_gshards(grads, opt_state, ccfg, wrap_ef, n, axis_name):
+    """The gradient hop of a ZeRO step: exact ``psum_scatter`` (ccfg
+    None) or the bucketed quantized reduce-scatter with error feedback
+    (`comm.compress.reduce_scatter_rows`).  Returns ``(gshards,
+    inner_opt_state, new_ef_or_None)`` — gshards in the per-leaf (1, k)
+    row format either way (inside shard_map)."""
+    if ccfg is None:
+        return _reduce_scatter_grads(grads, n, axis_name), opt_state, None
+    from tpu_dist.comm import compress as compress_mod
+
+    plan = compress_mod.FlatPlan(grads, n, ccfg)
+    res = opt_state["ef"]["residual"][0] if wrap_ef else None
+    local, new_res, stats = compress_mod.reduce_scatter_rows(
+        plan.to_rows(grads), res, plan, axis_name
+    )
+    gshards = plan.shard_rows(local / n)
+    inner = opt_state["opt"] if wrap_ef else opt_state
+    new_ef = (
+        {"residual": new_res[None], "err": stats["err"]} if wrap_ef else None
+    )
+    return gshards, inner, new_ef
+
+
 def _accumulate_grads(loss_grad_fn, params, batch, key, accum_steps: int):
     """Microbatch gradient accumulation for the sharded step builders —
     the stateless adapter over the shared scan
@@ -252,6 +290,7 @@ def make_fsdp_train_step(
     grad_pmean_axes: tuple[str, ...] = (),
     batch_spec=None,
     accum_steps: int = 1,
+    grad_compress=None,
 ):
     """Build the compiled FSDP train step.
 
@@ -285,16 +324,36 @@ def make_fsdp_train_step(
     ``step(sharded_params, opt_state, batch, key) -> (sharded_params,
     opt_state, loss, aux)`` — batch sharded on its leading axis, loss
     replicated (pmean), params/opt-state permanently sharded.
+
+    ``grad_compress`` (a `comm.compress.CompressConfig` or spec string)
+    swaps the gradient ``psum_scatter`` for the bucketed quantized
+    reduce-scatter with error feedback (`comm.compress`): each rank
+    ships 1-byte (or bf16) bucket chunks instead of f32 and dequantizes
+    into its exact shard rows.  The returned ``opt_state`` then becomes
+    ``{"opt": <state>, "ef": <residual>}``; data-axis only (incompatible
+    with ``grad_pmean_axes``).
     """
     n = mesh.shape[axis_name]
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    ccfg, wrap_ef = _compress_setup(
+        grad_compress, grad_pmean_axes, "make_fsdp_train_step"
+    )
     opt_update = _sharded_update_fn(optimizer, "make_fsdp_train_step")
     template = jax.tree.map(
         lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
     )
     sharded_params = fsdp_shard_params(params, mesh, axis_name)
     opt_state = _commit_scalars(optimizer.init(sharded_params), mesh)
+    if wrap_ef:
+        from tpu_dist.comm import compress as compress_mod
+
+        opt_state = {
+            "opt": opt_state,
+            "ef": compress_mod.init_ef_state(
+                template, n, ccfg, mesh, axis_name
+            ),
+        }
     vg = jax.value_and_grad(loss_fn, has_aux=True)
 
     def spmd_step(local_shards, opt_state, batch, key):
@@ -309,10 +368,14 @@ def make_fsdp_train_step(
         grads, loss, aux = _apply_grad_contract(
             grads, loss, aux, axis_name, grad_pmean_axes
         )
-        gshards = _reduce_scatter_grads(grads, n, axis_name)
-        new_shards, new_opt = opt_update(
-            local_shards, gshards, opt_state, axis_name
+        gshards, inner_opt, new_ef = _compressed_gshards(
+            grads, opt_state, ccfg, wrap_ef, n, axis_name
         )
+        new_shards, new_opt = opt_update(
+            local_shards, gshards, inner_opt, axis_name
+        )
+        if wrap_ef:
+            new_opt = {"opt": new_opt, "ef": new_ef}
         return new_shards, new_opt, loss, aux
 
     p_specs = jax.tree.map(_spec_of(axis_name), sharded_params)
@@ -355,6 +418,7 @@ def make_zero1_train_step(
     accum_steps: int = 1,
     grad_pmean_axes: tuple[str, ...] = (),
     batch_spec=None,
+    grad_compress=None,
 ):
     """ZeRO-1: replicated parameters, SHARDED optimizer state — the
     middle point between replicated DP and FSDP/ZeRO-3.
@@ -385,6 +449,9 @@ def make_zero1_train_step(
     n = mesh.shape[axis_name]
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    ccfg, wrap_ef = _compress_setup(
+        grad_compress, grad_pmean_axes, "make_zero1_train_step"
+    )
     opt_update = _sharded_update_fn(optimizer, "make_zero1_train_step")
     vg = jax.value_and_grad(loss_fn, has_aux=True)
     template = jax.tree.map(
@@ -398,6 +465,15 @@ def make_zero1_train_step(
     opt_state = _commit_scalars(
         optimizer.init(fsdp_shard_params(params, mesh, axis_name)), mesh
     )
+    if wrap_ef:
+        from tpu_dist.comm import compress as compress_mod
+
+        opt_state = {
+            "opt": opt_state,
+            "ef": compress_mod.init_ef_state(
+                template, n, ccfg, mesh, axis_name
+            ),
+        }
 
     def local_rows(full):
         """This rank's (1, k) row of each padded-flat leaf."""
@@ -420,10 +496,14 @@ def make_zero1_train_step(
         grads, loss, aux = _apply_grad_contract(
             grads, loss, aux, axis_name, grad_pmean_axes
         )
-        gshards = _reduce_scatter_grads(grads, n, axis_name)
-        new_rows, new_opt = opt_update(
-            local_rows(full_params), gshards, opt_state, axis_name
+        gshards, inner_opt, new_ef = _compressed_gshards(
+            grads, opt_state, ccfg, wrap_ef, n, axis_name
         )
+        new_rows, new_opt = opt_update(
+            local_rows(full_params), gshards, inner_opt, axis_name
+        )
+        if wrap_ef:
+            new_opt = {"opt": new_opt, "ef": new_ef}
         return (
             _unshard_rows(new_rows, template, axis_name),
             new_opt,

@@ -88,6 +88,14 @@ class LMTrainConfig:
     # with fsdp/zero1/accum_steps; mutually exclusive with the other
     # model-sharding modes.
     moe: bool = False
+    # Bucketed error-feedback compressed gradient sync (comm.compress):
+    # a wire spec like 'int8' / 'fp8' / 'float8_e5m2' / 'bf16'.  Works in
+    # dp (compressed allreduce) and fsdp/zero1 (compressed
+    # reduce-scatter); the quantization residual is step state riding
+    # the optimizer-state checkpoint.  None = follow TPU_DIST_COMPRESS;
+    # 'off' = force-disable.  Mutually exclusive with the model-sharding
+    # modes (tensor/sequence/pipeline/moe).
+    grad_compress: str | None = None
     # Global-norm gradient clipping (LM-training staple).  Wraps the
     # optimizer in `train.clip_by_global_norm`, whose shard_update psums
     # squared shard norms — so clipping is by the TRUE global norm under
@@ -143,6 +151,20 @@ class LMTrainer:
             )
 
         self._sharded_mode = self.config.fsdp or self.config.zero1
+        # Compressed gradient sync: resolved (and VALIDATED — a typo'd
+        # wire dtype fails here, not at trace time) from config or the
+        # TPU_DIST_COMPRESS env var.
+        from tpu_dist.comm import compress as compress_mod
+
+        self._compress = compress_mod.resolve(self.config.grad_compress)
+        self._wrap_ef = (
+            self._compress is not None and self._compress.error_feedback
+        )
+        # Compressed replicated training checkpoints via the SHARDED
+        # directory format too: the error-feedback residual is per-rank
+        # (sharded P(data)), which the single-writer npz cannot hold on
+        # a multi-process mesh.
+        self._sharded_ckpt = self._sharded_mode or self._wrap_ef
         if self.config.loss_scale is not None and not self.config.nan_guard:
             raise ValueError("loss_scale requires nan_guard=True")
         if self.config.nan_guard:
@@ -176,6 +198,14 @@ class LMTrainer:
             raise ValueError(
                 "tensor_parallel, sequence_parallel, pipeline, and moe "
                 "are mutually exclusive trainer modes"
+            )
+        if self._compress is not None and (
+            tp is not None or sp is not None or pp is not None or moe
+        ):
+            raise ValueError(
+                "grad_compress compresses the pure data-axis gradient "
+                "sync only — not combinable with tensor/sequence/"
+                "pipeline/moe model sharding"
             )
         if moe:
             world_data = mesh.shape.get(parallel.DATA_AXIS)
@@ -312,6 +342,7 @@ class LMTrainer:
                         (self.config.model_axis,) if tp is not None else ()
                     ),
                     batch_spec=self._batch_spec,
+                    grad_compress=self._compress,
                 )
             else:
                 fstep, p_sh, o_sh = parallel.make_zero1_train_step(
@@ -321,6 +352,7 @@ class LMTrainer:
                         (self.config.model_axis,) if tp is not None else ()
                     ),
                     batch_spec=self._batch_spec,
+                    grad_compress=self._compress,
                 )
             assert_no_aliasing(p_sh, o_sh)
             self.params, self.opt_state = p_sh, o_sh
@@ -340,7 +372,16 @@ class LMTrainer:
             elif sp is not None:
                 extra = (self.config.seq_axis,)
             self.params = parallel.replicate(params, mesh)
-            self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
+            inner_opt = parallel.replicate(self.optimizer.init(params), mesh)
+            if self._wrap_ef:
+                # The error-feedback residual rides the opt-state slot
+                # (per-rank step state, checkpointed with the optimizer).
+                self.opt_state = compress_mod.wrap_opt_state(
+                    inner_opt, params, mesh.shape[parallel.DATA_AXIS],
+                    self._compress, mesh, parallel.DATA_AXIS,
+                )
+            else:
+                self.opt_state = inner_opt
             assert_no_aliasing(self.params, self.opt_state)
             self.step = parallel.make_stateful_train_step(
                 loss_fn, self.optimizer, mesh,
@@ -352,8 +393,18 @@ class LMTrainer:
                     (self.config.pipe_axis,) if pp is not None else ()
                 ),
                 batch_spec=self._batch_spec,
+                grad_compress=self._compress,
             )
         self._model_state = parallel.replicate({}, mesh)
+        # Wire accounting for telemetry (static per step): what the
+        # compressed sync ships vs what exact fp32 would.
+        self._compress_summary = None
+        if self._compress is not None:
+            self._compress_summary = compress_mod.FlatPlan(
+                params, mesh.shape[parallel.DATA_AXIS], self._compress
+            ).wire_summary(
+                "reduce_scatter" if self._sharded_mode else "all_reduce"
+            )
 
     def _full_params(self):
         """Full (logical-shape) parameters for eval/decode — identity for
@@ -395,6 +446,7 @@ class LMTrainer:
         telemetry = metrics_mod.TrainTelemetry(
             world=self.world, mesh=self.mesh, config=cfg, trainer="LMTrainer"
         )
+        telemetry.set_compress(self._compress_summary)
         ok = False
         try:
             history = self._fit_loop(
@@ -416,6 +468,7 @@ class LMTrainer:
     ) -> list[LMEpochStats]:
         """The epoch/step loop of `fit` (split out so fit can wrap it in
         the telemetry try/finally)."""
+        from tpu_dist.comm import compress as compress_mod
         from tpu_dist.data.loader import HostLoader
         from tpu_dist.resilience.preempt import PreemptionGuard
         from tpu_dist.train import checkpoint as ckpt_mod
@@ -502,7 +555,7 @@ class LMTrainer:
                             "params": self.params, "opt_state": self.opt_state
                         }
                         with telemetry.goodput.measure("checkpoint") as ck:
-                            if self._sharded_mode:
+                            if self._sharded_ckpt:
                                 path = f"{checkpoint_dir}/lm_ckpt_preempt"
                                 ckpt_mod.save_sharded(path, tree, step=epoch)
                             else:
@@ -550,10 +603,13 @@ class LMTrainer:
                     tokens_per_sec=round(tps, 3), val_loss=vloss,
                     val_perplexity=vppl, bad_steps=bad,
                 )
+                telemetry.compress_done(
+                    error=compress_mod.ef_error(self.opt_state), epoch=epoch
+                )
                 if checkpoint_dir:
                     tree = {"params": self.params, "opt_state": self.opt_state}
                     with telemetry.goodput.measure("checkpoint") as ck:
-                        if self._sharded_mode:
+                        if self._sharded_ckpt:
                             # sharded format = a DIRECTORY of shard files — no
                             # .npz suffix (ADVICE r2: a dir named .npz misleads)
                             path = f"{checkpoint_dir}/lm_ckpt_{epoch}"
@@ -567,13 +623,22 @@ class LMTrainer:
         return history
 
     def restore(self, path) -> int:
+        from tpu_dist.comm import compress as compress_mod
         from tpu_dist.train import checkpoint
 
         like = {"params": self.params, "opt_state": self.opt_state}
-        if self._sharded_mode:
+        if self._sharded_ckpt:
+            # Rebuilt under the templates' shardings — replicated leaves
+            # come back replicated, the EF residual comes back P(data).
             state, epoch = checkpoint.restore_fsdp(path, like)
             self.params = state["params"]
-            self.opt_state = state["opt_state"]
+            # A different-world-size checkpoint flat-copies fsdp rows
+            # validly (zero padding) but would misdirect the dense
+            # per-rank residual — zero it instead.
+            self.opt_state = compress_mod.reset_resized_residual(
+                state["opt_state"], checkpoint.read_meta(path),
+                axis_name=parallel.DATA_AXIS,
+            )
             return epoch
         state, epoch = checkpoint.restore(path, like)
         self.params = parallel.replicate(state["params"], self.mesh)

@@ -10,6 +10,7 @@ hooks for perfetto inspection of ICI overlap.
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 from dataclasses import dataclass, field
 
@@ -151,6 +152,19 @@ class TrainTelemetry:
         self._bad_g = reg.gauge(
             "tpu_dist_bad_steps", "cumulative NaN-guard skipped steps"
         )
+        self._wire_c = reg.counter(
+            "tpu_dist_bytes_on_wire_total",
+            "gradient-sync bytes shipped per rank (compressed wire)",
+        )
+        self._saved_c = reg.counter(
+            "tpu_dist_bytes_saved_total",
+            "gradient-sync bytes saved per rank vs exact fp32",
+        )
+        self._cerr_g = reg.gauge(
+            "tpu_dist_compression_error",
+            "relative quantization error of the last compressed sync",
+        )
+        self._compress_summary: dict | None = None
         self._every = observe.events.step_every()
         self.world = world
         self.global_step = 0
@@ -341,6 +355,10 @@ class TrainTelemetry:
         self._steps_c.inc()
         self._loss_g.set(loss)
         self._step_h.observe(step_seconds)
+        cs = self._compress_summary
+        if cs is not None:  # wire cost is static per step — count it here
+            self._wire_c.inc(cs["bytes_on_wire"])
+            self._saved_c.inc(cs["bytes_exact"] - cs["bytes_on_wire"])
         if not self.enabled or sid % self._every:
             return
         from tpu_dist.train import flops as flops_mod
@@ -365,6 +383,33 @@ class TrainTelemetry:
             hbm=device_memory_stats(),
             **extra,
         )
+
+    def set_compress(self, summary: dict | None) -> None:
+        """Arm per-step wire accounting: ``summary`` is a
+        `comm.compress.FlatPlan.wire_summary` dict (None = sync is
+        uncompressed; all compress telemetry stays silent)."""
+        self._compress_summary = summary
+
+    def compress_done(self, *, error: float | None, epoch: int) -> None:
+        """Per-epoch compressed-sync record: the `compression_error`
+        gauge plus a ``compress`` event carrying the wire accounting.
+        No-op unless `set_compress` armed a summary."""
+        cs = self._compress_summary
+        if cs is None:
+            return
+        if error is not None and math.isfinite(error):
+            self._cerr_g.set(error)
+        if self.enabled:
+            self.events.emit(
+                "compress",
+                epoch=epoch,
+                wire=cs["wire"],
+                mode=cs["mode"],
+                buckets=cs["buckets"],
+                bytes_on_wire=cs["bytes_on_wire"],
+                bytes_saved=cs["bytes_exact"] - cs["bytes_on_wire"],
+                compression_error=error,
+            )
 
     def epoch_done(self, *, epoch: int, mean_loss: float, seconds: float,
                    **extra) -> None:

@@ -65,9 +65,18 @@ class TrainConfig:
     zero1: bool = False
     # Gradient-reduction backend: 'psum' (XLA AllReduce, exact,
     # default), 'ring' (the hand-rolled chunked ppermute ring, exact),
-    # 'int8' / 'fp8' (quantized, 4x less ICI traffic, lossy at gradient-
-    # noise level).  Replicated-DP mode only.
+    # 'int8' / 'fp8' (per-leaf quantized, 4x less ICI traffic, lossy at
+    # gradient-noise level).  Replicated-DP mode only.
     grad_reduce: str = "psum"
+    # Bucketed error-feedback compressed gradient sync (comm.compress):
+    # a wire spec like 'int8' / 'fp8' / 'float8_e5m2' / 'bf16' (optionally
+    # 'int8,bucket_mb=4,block=256').  Works in dp AND fsdp/zero1 (the
+    # reduce-scatter hop compresses too); the quantization residual is
+    # train-step state that rides the optimizer-state checkpoint —
+    # which therefore uses the sharded DIRECTORY format (the residual
+    # is per-rank, so a single-writer npz cannot hold it multi-host).
+    # None = follow the TPU_DIST_COMPRESS env var; 'off' = force-disable.
+    grad_compress: str | None = None
     # NaN guard (resilience.nan_guard): fused non-finite detection on
     # loss/grads inside the compiled step — a bad step is skipped
     # (params/opt state unchanged), counted (EpochStats.bad_steps), and
@@ -116,6 +125,20 @@ class Trainer:
         self.world = int(np.prod(mesh.devices.shape))
         self.optimizer = optimizer or sgd(self.config.lr, self.config.momentum)
         self._loss = loss
+        # Compressed gradient sync: resolved (and VALIDATED — a typo'd
+        # wire dtype fails here, not at trace time) from config or the
+        # TPU_DIST_COMPRESS env var.
+        from tpu_dist.comm import compress as compress_mod
+
+        self._compress = compress_mod.resolve(self.config.grad_compress)
+        self._wrap_ef = (
+            self._compress is not None and self._compress.error_feedback
+        )
+        if self._compress is not None and self.config.grad_reduce != "psum":
+            raise ValueError(
+                "grad_compress replaces the gradient reduce — leave "
+                f"grad_reduce='psum', not {self.config.grad_reduce!r}"
+            )
         if self.config.loss_scale is not None and not self.config.nan_guard:
             raise ValueError("loss_scale requires nan_guard=True")
         if self.config.nan_guard:
@@ -155,7 +178,16 @@ class Trainer:
         if not sharded_mode:
             self.params = parallel.replicate(params, mesh)
             self.model_state = parallel.replicate(state, mesh)
-            self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
+            inner_opt = parallel.replicate(self.optimizer.init(params), mesh)
+            if self._wrap_ef:
+                # The error-feedback residual is per-rank train-step
+                # state riding the opt-state slot (checkpointed with it).
+                self.opt_state = compress_mod.wrap_opt_state(
+                    inner_opt, params, mesh.shape[parallel.DATA_AXIS],
+                    self._compress, mesh, parallel.DATA_AXIS,
+                )
+            else:
+                self.opt_state = inner_opt
             # The step donates all three trees; any buffer shared between
             # them (e.g. an optimizer init that returns params leaves
             # uncopied — device_put maps equal inputs to ONE buffer) would be
@@ -213,6 +245,7 @@ class Trainer:
             fstep, p_sh, o_sh = make(
                 fsdp_loss, self.optimizer, mesh, params,
                 accum_steps=self.config.accum_steps,
+                grad_compress=self._compress,
             )
             # Same donation guard as the replicated path: the fsdp step
             # donates both trees, so a buffer shared between them (e.g. an
@@ -237,6 +270,16 @@ class Trainer:
                 loss_fn, self.optimizer, mesh,
                 accum_steps=self.config.accum_steps,
                 grad_reduce=self.config.grad_reduce,
+                grad_compress=self._compress,
+            )
+        # Wire accounting for telemetry (static per step): what the
+        # compressed sync ships vs what exact fp32 would.
+        self._compress_summary = None
+        if self._compress is not None:
+            self._compress_summary = compress_mod.FlatPlan(
+                params, mesh.shape[parallel.DATA_AXIS], self._compress
+            ).wire_summary(
+                "reduce_scatter" if sharded_mode else "all_reduce"
             )
         self._eval_apply = jax.jit(
             lambda params, state, x: model.apply(params, state, x, train=False)[0]
@@ -248,6 +291,27 @@ class Trainer:
         save/restore/fit must all agree on it."""
         return self.config.fsdp or self.config.zero1
 
+    @property
+    def _sharded_ckpt(self) -> bool:
+        """Whether checkpoints use the per-shard-file DIRECTORY format.
+        True for fsdp/zero1 state, and ALSO for compressed replicated
+        training: the error-feedback residual is per-rank (sharded over
+        the data axis), so the single-writer npz — which materializes
+        every leaf on process 0 — cannot hold it on a multi-process
+        mesh; the sharded writer has each process write its own rows."""
+        return self._sharded_mode or self._wrap_ef
+
+    def _ckpt_tree(self) -> dict:
+        """The checkpointed state tree (sharded modes drop model_state —
+        fsdp/zero1 support stateless models only)."""
+        if self._sharded_mode:
+            return {"params": self.params, "opt_state": self.opt_state}
+        return {
+            "params": self.params,
+            "model_state": self.model_state,
+            "opt_state": self.opt_state,
+        }
+
     def save(self, path, *, epoch: int = 0, async_writer=None) -> None:
         """Checkpoint the full training state (params, model state,
         optimizer) — single writer, replicas identical (SURVEY.md §5).
@@ -255,20 +319,15 @@ class Trainer:
         file write overlaps subsequent training steps."""
         from tpu_dist.train import checkpoint
 
-        if self._sharded_mode:
-            # Sharded state: per-shard files, no global array materialized
-            # (``path`` becomes a directory — see checkpoint.save_sharded).
-            tree = {"params": self.params, "opt_state": self.opt_state}
+        tree = self._ckpt_tree()
+        if self._sharded_ckpt:
+            # Per-shard files, no global array materialized (``path``
+            # becomes a directory — see checkpoint.save_sharded).
             if async_writer is not None:
                 async_writer.save_sharded(path, tree, step=epoch)
             else:
                 checkpoint.save_sharded(path, tree, step=epoch)
             return
-        tree = {
-            "params": self.params,
-            "model_state": self.model_state,
-            "opt_state": self.opt_state,
-        }
         if async_writer is not None:
             async_writer.save(path, tree, step=epoch)
         else:
@@ -277,19 +336,26 @@ class Trainer:
     def restore(self, path) -> int:
         """Restore state saved by `save`; returns the stored epoch index
         (resume point)."""
+        from tpu_dist.comm import compress as compress_mod
         from tpu_dist.train import checkpoint
 
-        if self._sharded_mode:
-            like = {"params": self.params, "opt_state": self.opt_state}
+        like = self._ckpt_tree()
+        if self._sharded_ckpt:
+            # Rebuilt under the templates' shardings — replicated leaves
+            # come back replicated, the EF residual comes back P(data).
             restored, epoch = checkpoint.restore_fsdp(path, like)
             self.params = restored["params"]
-            self.opt_state = restored["opt_state"]
+            # A checkpoint from a DIFFERENT world size flat-copies fsdp
+            # rows validly (zero padding) but would misdirect the dense
+            # per-rank residual — zero it instead (one step of re-paid
+            # quantization error, not garbage feedback).
+            self.opt_state = compress_mod.reset_resized_residual(
+                restored["opt_state"], checkpoint.read_meta(path),
+                axis_name=parallel.DATA_AXIS,
+            )
+            if not self._sharded_mode:
+                self.model_state = restored["model_state"]
             return epoch
-        like = {
-            "params": self.params,
-            "model_state": self.model_state,
-            "opt_state": self.opt_state,
-        }
         state, epoch = checkpoint.restore(path, like)
         self.params = parallel.replicate(state["params"], self.mesh)
         self.model_state = parallel.replicate(state["model_state"], self.mesh)
@@ -337,12 +403,13 @@ class Trainer:
         from tpu_dist.train.checkpoint import AsyncCheckpointer
 
         ckpt_writer = AsyncCheckpointer() if checkpoint_dir is not None else None
-        suffix = "" if self._sharded_mode else ".npz"
+        suffix = "" if self._sharded_ckpt else ".npz"
         # Opt-in telemetry (TPU_DIST_TELEMETRY): manifest + per-step JSONL
         # events, heartbeat, host spans, goodput — see docs/observability.md.
         telemetry = metrics_mod.TrainTelemetry(
             world=self.world, mesh=self.mesh, config=cfg, trainer="Trainer"
         )
+        telemetry.set_compress(self._compress_summary)
         ok = False
         try:
             history = self._fit_loop(
@@ -364,6 +431,7 @@ class Trainer:
     ) -> list[EpochStats]:
         """The epoch/step loop of `fit` (split out so fit can wrap it in
         the telemetry try/finally)."""
+        from tpu_dist.comm import compress as compress_mod
         from tpu_dist.resilience.preempt import PreemptionGuard
         from tpu_dist.train import metrics as metrics_mod
 
@@ -476,6 +544,9 @@ class Trainer:
                     epoch=epoch, mean_loss=mean_loss, seconds=dt,
                     samples_per_sec=round(sps, 3), eval_accuracy=acc,
                     bad_steps=bad,
+                )
+                telemetry.compress_done(
+                    error=compress_mod.ef_error(self.opt_state), epoch=epoch
                 )
                 if checkpoint_dir is not None:
                     path = f"{checkpoint_dir}/ckpt_{epoch}{suffix}"
