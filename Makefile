@@ -9,7 +9,8 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
 .PHONY: test chaos ptp gather allreduce train bench runtime train-image \
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
-        chaos-resume docs demos telemetry-demo bench-dispatch bench-compress
+        chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
+        bench-pipeline
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -55,6 +56,10 @@ bench-dispatch:  # sync vs K-deep pipelined dispatch on the parity workload
 
 bench-compress:  # gradient-sync backends + bucket-size sweep (bytes-on-wire, GB/s)
 	$(PY) benchmarks/grad_reduce.py --platform $(PLATFORM) --world $(WORLD) --bucket-sweep
+
+bench-pipeline:  # 1F1B vs GPipe vs pure dp goodput at equal chips (matched depth)
+	$(PY) benchmarks/lm_train.py --platform $(PLATFORM) --pipeline 1f1b
+	$(PY) benchmarks/lm_train.py --platform $(PLATFORM) --pipeline gpipe --pipe-blocks 2
 
 runtime:
 	$(MAKE) -C tpu_dist/runtime

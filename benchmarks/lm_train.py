@@ -80,22 +80,209 @@ def build_args(argv=None):
         "--remat-from", type=int, default=4096,
         help="use jax.checkpoint for seq >= this (memory headroom)",
     )
+    # --pipeline switches to the pipeline-parallel goodput bench: a
+    # pipe x dp mesh vs pure dp at EQUAL chips, with the schedule
+    # engine's measured bubble fraction in the record.
+    ap.add_argument(
+        "--pipeline", choices=["gpipe", "1f1b"], default=None,
+        help="run the pipeline goodput bench with this schedule instead "
+        "of the MFU sweep",
+    )
+    ap.add_argument("--pipe-world", type=int, default=4)
+    ap.add_argument("--dp-world", type=int, default=2)
+    ap.add_argument("--pipe-microbatches", type=int, default=8)
+    ap.add_argument("--pipe-interleave", type=int, default=2)
+    ap.add_argument(
+        "--pipe-blocks", type=int, default=1,
+        help="transformer blocks per virtual-stage chunk (model depth = "
+        "pipe-world x interleave x this)",
+    )
+    ap.add_argument("--pipe-dim", type=int, default=128)
+    ap.add_argument("--pipe-heads", type=int, default=4)
+    ap.add_argument("--pipe-vocab", type=int, default=512)
+    ap.add_argument("--pipe-seq", type=int, default=128)
+    ap.add_argument("--pipe-batch", type=int, default=32)
+    ap.add_argument("--pipe-steps", type=int, default=6)
+    ap.add_argument("--no-persist", action="store_true")
     return ap.parse_args(argv)
 
 
 def main():
     args = build_args()
 
+    # pipeline mode needs pipe_world x dp_world simulated devices
+    n_devices = (
+        max(8, args.pipe_world * args.dp_world) if args.pipeline else None
+    )
     if args.platform == "cpu":
         from tpu_dist.utils.platform import pin_cpu
 
-        pin_cpu()
+        pin_cpu(n_devices)
     elif args.platform is None:
         from tpu_dist.utils.platform import pin_cpu_if_backend_dead
 
-        pin_cpu_if_backend_dead()
+        pin_cpu_if_backend_dead(n_devices)
 
+    if args.pipeline:
+        print(json.dumps(pipeline_sweep(args)))
+        return
     print(json.dumps(sweep(args)))
+
+
+def _measure_steps(trainer, batch, steps: int, warmup: int):
+    """Mean step seconds over ``steps`` timed iterations (data-dependent
+    chain closed by a host readback — the round-2 timing discipline)."""
+    import jax
+
+    from tpu_dist.utils.platform import host_sync
+
+    p, ms, os_ = trainer.params, trainer._model_state, trainer.opt_state
+    key = jax.random.key(0)
+    loss = None
+    for _ in range(warmup):
+        p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
+    if loss is not None:  # --warmup 0: nothing dispatched yet to sync on
+        host_sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        p, ms, os_, loss, _ = trainer.step(p, ms, os_, batch, key)
+    final = float(host_sync(loss))
+    dt = time.perf_counter() - t0
+    return dt / steps, final
+
+
+def pipeline_sweep(args) -> dict:
+    """Pipeline-parallel goodput vs pure dp at EQUAL chips.
+
+    Three trainers on the live backend: pure dp over all
+    ``pipe_world x dp_world`` chips, and the requested pipeline schedule
+    on a (data x pipe) mesh — same model, same global batch, same
+    optimizer.  Reports tokens/s goodput, the schedule engine's MEASURED
+    bubble fraction (idle cells of the executed table), and the
+    activation-stash depth; persists one record per mode to
+    ``benchmarks/results/bench_runs.jsonl``."""
+    import numpy as np
+    import jax
+
+    from tpu_dist import comm, models, parallel, train
+    from tpu_dist.parallel.pipeline import build_schedule
+
+    pw, dpw = args.pipe_world, args.dp_world
+    chips = pw * dpw
+    if len(jax.devices()) < chips:
+        raise SystemExit(
+            f"pipeline bench needs {chips} devices "
+            f"(pipe {pw} x dp {dpw}); have {len(jax.devices())}"
+        )
+    vi = args.pipe_interleave if args.pipeline == "1f1b" else 1
+    depth = pw * vi * args.pipe_blocks
+    M = args.pipe_microbatches
+    B, S = args.pipe_batch, args.pipe_seq
+    log(
+        f"pipeline bench: {args.pipeline} n={pw} dp={dpw} M={M} v={vi} "
+        f"depth={depth} dim={args.pipe_dim} batch={B} seq={S}"
+    )
+
+    def make_lm():
+        return models.TransformerLM(
+            vocab=args.pipe_vocab, dim=args.pipe_dim, depth=depth,
+            heads=args.pipe_heads, max_seq=S,
+        )
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, args.pipe_vocab, (B, S)).astype(np.int32)
+
+    rows = {}
+    # pure dp baseline at equal chips
+    dp_mesh = comm.make_mesh(chips, ("data",), mesh_devices=jax.devices()[:chips])
+    dp_tr = train.LMTrainer(
+        make_lm(), dp_mesh,
+        train.LMTrainConfig(global_batch=B, log=log),
+    )
+    dp_batch = parallel.shard_batch((toks,), dp_mesh)
+    step_s, loss = _measure_steps(dp_tr, dp_batch, args.pipe_steps, args.warmup)
+    rows["dp"] = {
+        "mode": "dp", "chips": chips, "step_ms": round(step_s * 1e3, 2),
+        "tokens_per_sec": round(B * S / step_s, 1), "loss": round(loss, 4),
+        "bubble_fraction": None,
+    }
+
+    # the pipeline mode under test on the (data x pipe) mesh
+    pipe_mesh = comm.make_mesh(
+        (dpw, pw), ("data", "pipe"), mesh_devices=jax.devices()[:chips]
+    )
+    pipe_tr = train.LMTrainer(
+        make_lm(), pipe_mesh,
+        train.LMTrainConfig(
+            global_batch=B, pipeline=args.pipeline,
+            pipe_microbatches=M, pipe_interleave=args.pipe_interleave,
+            log=log,
+        ),
+    )
+    pipe_batch = parallel.shard_batch((toks,), pipe_mesh)
+    step_s, loss = _measure_steps(
+        pipe_tr, pipe_batch, args.pipe_steps, args.warmup
+    )
+    summary = pipe_tr._pipe_summary
+    rows[args.pipeline] = {
+        "mode": args.pipeline, "chips": chips, "pipe_world": pw,
+        "dp_world": dpw, "microbatches": M, "interleave": vi,
+        "schedule_kind": summary["kind"],
+        "schedule_ticks": summary["ticks"],
+        "stash_depth": summary["stash_depth"],
+        "step_ms": round(step_s * 1e3, 2),
+        "tokens_per_sec": round(B * S / step_s, 1),
+        "loss": round(loss, 4),
+        "bubble_fraction": summary["bubble_fraction"],
+    }
+    # the GPipe flush bubble at the SAME (n, M): the number the 1F1B
+    # drain is measured against
+    gpipe_bubble = round(
+        build_schedule(pw, M, 1, "gpipe").bubble_fraction(), 6
+    )
+    out = {
+        "metric": "lm_pipeline_goodput",
+        "value": rows[args.pipeline]["tokens_per_sec"],
+        "unit": "tokens_per_sec",
+        "platform": jax.devices()[0].platform,
+        "pipeline": args.pipeline,
+        "model": {
+            "dim": args.pipe_dim, "depth": depth, "heads": args.pipe_heads,
+            "vocab": args.pipe_vocab, "seq": S, "global_batch": B,
+        },
+        "goodput_vs_dp": round(
+            rows[args.pipeline]["tokens_per_sec"]
+            / rows["dp"]["tokens_per_sec"], 4,
+        ),
+        "gpipe_bubble_at_same_nM": gpipe_bubble,
+        # null when gpipe IS the mode under test (comparing it to
+        # itself would read as a regression)
+        "bubble_below_gpipe": (
+            rows[args.pipeline]["bubble_fraction"] < gpipe_bubble
+            if args.pipeline != "gpipe"
+            else None
+        ),
+        "rows": rows,
+    }
+    for name, row in rows.items():
+        bub = row.get("bubble_fraction")
+        log(
+            f"[{name}] {row['step_ms']:.1f} ms/step  "
+            f"{row['tokens_per_sec']:,.0f} tok/s"
+            + (f"  bubble {bub:.1%}" if bub is not None else "")
+        )
+    if not args.no_persist:
+        import bench
+
+        for name, row in rows.items():
+            bench.persist_event({
+                "metric": "lm_pipeline_goodput",
+                "value": row["tokens_per_sec"],
+                "unit": "tokens_per_sec",
+                "bench": "lm_train_pipeline",
+                **row,
+            })
+    return out
 
 
 def sweep(args) -> dict:

@@ -1,0 +1,468 @@
+"""The schedule-driven TRUE 1F1B pipeline engine (ROADMAP item 4):
+schedule tables must be textbook (tick counts, bubble fraction, O(n·v)
+stash), and the executor's loss/gradients must match sequential
+execution — including under remat, gradient accumulation, the NaN
+guard, and the K-deep step pipeline."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tests.conftest import spmd_run as run
+from tpu_dist import comm, models, parallel, train
+from tpu_dist.parallel.pipeline import (
+    BWD,
+    FWD,
+    IDLE,
+    build_schedule,
+    pipeline_engine_loss,
+)
+
+N = 4  # pipe ranks
+D = 8
+
+
+# ------------------------------------------------------------- schedules
+
+
+def _check_valid(s):
+    """Structural invariants every schedule table must satisfy."""
+    n, M, v, T = s.n, s.n_microbatches, s.n_chunks, s.ticks
+    done = {}
+    for t in range(T):
+        for r in range(n):
+            op = s.ops[t, r]
+            if op == IDLE:
+                continue
+            c, m = int(s.chunk[t, r]), int(s.mb[t, r])
+            key = (int(op), c, m, r)
+            assert key not in done, f"duplicate op {key}"
+            done[key] = t
+            g = c * n + r
+            if op == FWD:
+                assert s.stash_push[t, r] >= 0
+                if g == 0:
+                    assert s.fwd_read[t, r] == -1  # injects
+                else:
+                    ps, pc = (r - 1, c) if r > 0 else (n - 1, c - 1)
+                    assert done[(FWD, pc, m, ps)] < t
+                    assert s.fwd_read[t, r] >= 0
+            else:
+                assert s.stash_pop[t, r] >= 0
+                if g == n * v - 1:
+                    assert s.bwd_read[t, r] == -1  # seeds from the loss
+                    assert done[(FWD, c, m, r)] < t
+                else:
+                    ds, dc = (r + 1, c) if r < n - 1 else (0, c + 1)
+                    assert done[(BWD, dc, m, ds)] < t
+                    assert s.bwd_read[t, r] >= 0
+    # every (F, B) x chunk x microbatch exactly once per owning rank
+    assert len(done) == 2 * M * v * n
+    assert s.stash_push.max() < s.stash_depth
+    assert s.fwd_write.max() < s.fwd_depth
+    assert s.bwd_write.max() < s.bwd_depth
+
+
+class TestScheduleTables:
+    @pytest.mark.parametrize(
+        "n,M,v,kind",
+        [
+            (4, 8, 1, "gpipe"), (4, 4, 1, "gpipe"),
+            (4, 8, 1, "1f1b"), (4, 4, 1, "1f1b"), (2, 8, 1, "1f1b"),
+            (8, 16, 1, "1f1b"),
+            (4, 8, 2, "interleaved_1f1b"), (2, 4, 2, "interleaved_1f1b"),
+            (4, 8, 4, "interleaved_1f1b"),
+        ],
+    )
+    def test_tables_are_valid(self, n, M, v, kind):
+        _check_valid(build_schedule(n, M, v, kind))
+
+    def test_tick_counts_are_textbook(self):
+        # both non-interleaved kinds: 2M work ticks + 2(n-1) drain
+        assert build_schedule(4, 8, 1, "gpipe").ticks == 2 * (8 + 3)
+        assert build_schedule(4, 8, 1, "1f1b").ticks == 2 * 8 + 2 * 3
+        # interleaved: 2·M·v chunk-ticks + 2(n-1) drain
+        assert build_schedule(4, 8, 2, "interleaved_1f1b").ticks == (
+            2 * 8 * 2 + 2 * 3
+        )
+
+    def test_bubble_fraction_measured_equals_textbook(self):
+        for n, M in ((4, 8), (4, 4), (8, 16)):
+            gp = build_schedule(n, M, 1, "gpipe")
+            assert gp.bubble_fraction() == pytest.approx((n - 1) / (M + n - 1))
+            f = build_schedule(n, M, 1, "1f1b")
+            # equal-cost F/B ticks: 1F1B matches GPipe's bubble (its win
+            # at v=1 is MEMORY); interleaving is what shrinks the drain
+            assert f.bubble_fraction() == pytest.approx((n - 1) / (M + n - 1))
+        for v in (2, 4):
+            il = build_schedule(4, 8, v, "interleaved_1f1b")
+            assert il.bubble_fraction() == pytest.approx(3 / (8 * v + 3))
+            assert il.bubble_fraction() < build_schedule(
+                4, 8, 1, "gpipe"
+            ).bubble_fraction()
+
+    def test_stash_high_water_is_schedule_not_microbatch_bound(self):
+        """The acceptance claim: 1F1B stash is O(n·v), GPipe's is O(M) —
+        doubling M doubles GPipe's stash and leaves 1F1B's unchanged."""
+        n = 4
+        for M in (4, 8, 16):
+            assert build_schedule(n, M, 1, "gpipe").stash_high_water() == M
+        f8 = build_schedule(n, 8, 1, "1f1b")
+        f16 = build_schedule(n, 16, 1, "1f1b")
+        assert f8.stash_high_water() <= n  # O(n·v), v=1
+        assert f16.stash_high_water() == f8.stash_high_water()
+        v = 2
+        i8 = build_schedule(n, 8, v, "interleaved_1f1b")
+        i16 = build_schedule(n, 16, v, "interleaved_1f1b")
+        assert i16.stash_high_water() == i8.stash_high_water()
+        # Megatron warmup: ≤ 2(n-1) + (v-1)·n + 1 in-flight chunk inputs
+        assert i8.stash_high_water() <= 2 * (n - 1) + (v - 1) * n + 1
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            build_schedule(4, 8, 1, "pipedream")
+        with pytest.raises(ValueError, match="n_chunks=1"):
+            build_schedule(4, 8, 2, "gpipe")
+        with pytest.raises(ValueError, match="multiple"):
+            build_schedule(4, 6, 2, "interleaved_1f1b")
+        # v=1 interleaving IS the classic schedule
+        assert build_schedule(4, 8, 1, "interleaved_1f1b").kind == "1f1b"
+
+
+# ---------------------------------------------------------- toy executor
+
+
+def _stage_fn(p, x):
+    return jax.nn.tanh(x @ p["w"] + p["b"])
+
+
+def _head_apply(hp, y):
+    return y * hp["g"]
+
+
+def _last_fn(pc, hp, x_in, args):
+    (t,) = args
+    return jnp.mean((_head_apply(hp, _stage_fn(pc, x_in)) - t) ** 2)
+
+
+def _make_chunks(key, v):
+    ks = jax.random.split(key, N * v)
+    stages = [
+        {
+            "w": jax.random.normal(k, (D, D)) / jnp.sqrt(D),
+            "b": jax.random.normal(k, (D,)) * 0.1,
+        }
+        for k in ks
+    ]
+    nest = [[stages[c * N + s] for c in range(v)] for s in range(N)]
+    return parallel.stack_chunk_params(nest)
+
+
+def _seq_loss(stacked, hp, x, tgt, v):
+    y = x
+    for g in range(N * v):
+        c, s = divmod(g, N)
+        y = _stage_fn(jax.tree.map(lambda t: t[s, c], stacked), y)
+    return jnp.mean((_head_apply(hp, y) - tgt) ** 2)
+
+
+def _engine_fn(sched, remat=False):
+    def fn(stacked, hp, x, tgt):
+        r = comm.rank()
+
+        def loss(stacked, hp):
+            chunks_local = jax.tree.map(
+                lambda t: lax.dynamic_index_in_dim(t, r, 0, keepdims=False),
+                stacked,
+            )
+            return pipeline_engine_loss(
+                _stage_fn, _last_fn, sched, chunks_local, hp, x, (tgt,),
+                axis_name=comm.DEFAULT_AXIS, remat_stages=remat,
+            )
+
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1))(stacked, hp)
+        return l, jax.tree.map(
+            lambda a: lax.psum(a, comm.DEFAULT_AXIS), grads
+        )
+
+    return fn
+
+
+class TestEngineExecutor:
+    """Acceptance grid: n=4, v ∈ {1, 2}, M ∈ {4, 8} — engine loss and
+    psum'd grads equal sequential execution."""
+
+    @pytest.mark.parametrize(
+        "v,M,kind",
+        [
+            (1, 4, "1f1b"), (1, 8, "1f1b"), (1, 4, "gpipe"),
+            (2, 4, "interleaved_1f1b"), (2, 8, "interleaved_1f1b"),
+        ],
+    )
+    def test_matches_sequential(self, v, M, kind):
+        stacked = _make_chunks(jax.random.key(0), v)
+        hp = {"g": jnp.float32(1.3)}
+        x = jax.random.normal(jax.random.key(1), (16, D))
+        tgt = jax.random.normal(jax.random.key(2), (16, D))
+        l_seq = _seq_loss(stacked, hp, x, tgt, v)
+        g_seq = jax.grad(_seq_loss, argnums=(0, 1))(stacked, hp, x, tgt, v)
+
+        sched = build_schedule(N, M, v, kind)
+        l, (gs, gh) = run(_engine_fn(sched), stacked, hp, x, tgt, world=N)
+        np.testing.assert_allclose(np.asarray(l), float(l_seq), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(gs[k])[0], np.asarray(g_seq[0][k]),
+                rtol=1e-4, atol=1e-5,
+            )
+        np.testing.assert_allclose(
+            np.asarray(gh["g"])[0], np.asarray(g_seq[1]["g"]),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_remat_stages_grads_unchanged(self):
+        stacked = _make_chunks(jax.random.key(3), 1)
+        hp = {"g": jnp.float32(0.9)}
+        x = jax.random.normal(jax.random.key(4), (8, D))
+        tgt = jax.random.normal(jax.random.key(5), (8, D))
+        sched = build_schedule(N, 4, 1, "1f1b")
+        plain = run(_engine_fn(sched, remat=False), stacked, hp, x, tgt, world=N)
+        remat = run(_engine_fn(sched, remat=True), stacked, hp, x, tgt, world=N)
+        for a, b in zip(jax.tree.leaves(plain), jax.tree.leaves(remat)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6
+            )
+
+    def test_nan_microbatch_poisons_loss_and_grads(self):
+        """A NaN arising in ONE microbatch must reach the returned loss
+        and the gradient accumulators — the propagation the NaN guard's
+        skip-and-count relies on (no microbatch is silently dropped)."""
+        stacked = _make_chunks(jax.random.key(6), 1)
+        hp = {"g": jnp.float32(1.0)}
+        x = jax.random.normal(jax.random.key(7), (16, D))
+        tgt = np.array(jax.random.normal(jax.random.key(8), (16, D)))
+        tgt[8:12] = np.nan  # microbatch 2 of 4
+        sched = build_schedule(N, 4, 1, "1f1b")
+        l, (gs, gh) = run(
+            _engine_fn(sched), stacked, hp, x, jnp.asarray(tgt), world=N
+        )
+        assert not np.isfinite(np.asarray(l)).any()
+        assert not np.isfinite(np.asarray(gh["g"])).any()
+
+    def test_schedule_world_mismatch_raises(self):
+        stacked = _make_chunks(jax.random.key(0), 1)
+        hp = {"g": jnp.float32(1.0)}
+        x = jnp.ones((8, D))
+        sched = build_schedule(2, 4, 1, "1f1b")  # built for n=2, run on 4
+        with pytest.raises(ValueError, match="schedule built for"):
+            run(_engine_fn(sched), stacked, hp, x, x, world=N)
+
+
+# ------------------------------------------------------------ LM engine
+
+
+class TestLMEngine:
+    @pytest.mark.parametrize("v,M", [(1, 4), (1, 8), (2, 4), (2, 8)])
+    def test_grads_match_dense(self, v, M):
+        """`loss_pipeline_1f1b` on an n=4 pipe: psum over the pipe axis
+        of the per-rank grads equals the dense `lm_loss` gradient —
+        chunk grads on the owning rank, head grads on rank n-1, trunk
+        grads on rank 0, weight-tied table counted once."""
+        depth = N * v
+        lm = models.TransformerLM(
+            vocab=64, dim=32, depth=depth, heads=4, max_seq=16
+        )
+        params, _ = lm.init(jax.random.key(0))
+        tokens = models.synthetic_tokens(8, 8, 64)
+
+        def dense_loss(p):
+            logits, _ = lm.apply(p, {}, tokens)
+            return models.lm_loss(logits, tokens)
+
+        l_dense = float(dense_loss(params))
+        g_dense = jax.grad(dense_loss)(params)
+
+        def fn(params, tokens):
+            l, g = jax.value_and_grad(
+                lambda p: lm.loss_pipeline_1f1b(
+                    p, tokens, comm.DEFAULT_AXIS,
+                    n_microbatches=M, interleave=v,
+                )
+            )(params)
+            return l, jax.tree.map(
+                lambda a: lax.psum(a, comm.DEFAULT_AXIS), g
+            )
+
+        l, got = run(fn, params, tokens, world=N)
+        np.testing.assert_allclose(np.asarray(l), l_dense, rtol=1e-5)
+        for e, g in zip(
+            jax.tree.leaves(g_dense), jax.tree.leaves(got), strict=True
+        ):
+            np.testing.assert_allclose(
+                np.asarray(e), np.asarray(g)[0], rtol=2e-4, atol=2e-5
+            )
+
+    def test_engine_matches_scan_replay_path(self):
+        """Same loss AND same psum'd grads as the pre-engine
+        `loss_pipeline` scan-replay path (engine=False) — the parity
+        that lets the trainer route 1f1b through the engine."""
+        lm = models.TransformerLM(vocab=64, dim=32, depth=4, heads=4, max_seq=16)
+        params, _ = lm.init(jax.random.key(1))
+        tokens = models.synthetic_tokens(8, 8, 64, seed=3)
+
+        def fn(params, tokens, engine):
+            l, g = jax.value_and_grad(
+                lambda p: lm.loss_pipeline(
+                    p, tokens, comm.DEFAULT_AXIS,
+                    n_microbatches=4, interleave=2, engine=engine,
+                )
+            )(params)
+            return l, jax.tree.map(
+                lambda a: lax.psum(a, comm.DEFAULT_AXIS), g
+            )
+
+        world = 2
+        l_old, g_old = run(
+            lambda p, t: fn(p, t, False), params, tokens, world=world
+        )
+        l_new, g_new = run(
+            lambda p, t: fn(p, t, True), params, tokens, world=world
+        )
+        np.testing.assert_allclose(
+            np.asarray(l_new), np.asarray(l_old), rtol=1e-5
+        )
+        for a, b in zip(
+            jax.tree.leaves(g_old), jax.tree.leaves(g_new), strict=True
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            )
+
+
+# --------------------------------------------------------- trainer wiring
+
+
+VOCAB, DIM, SEQ, GB = 32, 16, 16, 8
+
+
+def _lm(depth=4):
+    return models.TransformerLM(
+        vocab=VOCAB, dim=DIM, depth=depth, heads=4, max_seq=SEQ
+    )
+
+
+def _windows(n=16):
+    return np.asarray(models.synthetic_tokens(n, SEQ, VOCAB))
+
+
+def _pipe_trainer(mesh, **overrides):
+    kw = dict(
+        epochs=1, global_batch=GB, pipeline="1f1b", pipe_microbatches=4,
+        pipe_interleave=2, log=lambda *_: None,
+    )
+    kw.update(overrides)
+    cfg = train.LMTrainConfig(**kw)
+    return train.LMTrainer(_lm(), mesh, cfg, optimizer=train.sgd(0.05))
+
+
+class TestTrainerEngine:
+    def test_accum_steps_match_dense_trajectory(self):
+        """1F1B x accum_steps=2: the engine runs once per accumulation
+        microbatch inside the scan and the trajectory still equals
+        dense."""
+        windows = _windows()
+        dense_mesh = comm.make_mesh(1, ("data",), platform="cpu")
+        dense = train.LMTrainer(
+            _lm(), dense_mesh,
+            train.LMTrainConfig(
+                epochs=1, global_batch=GB, log=lambda *_: None
+            ),
+            optimizer=train.sgd(0.05),
+        )
+        dense.fit(windows)
+        mesh = comm.make_mesh((1, 2), ("data", "pipe"), platform="cpu")
+        t = _pipe_trainer(mesh, accum_steps=2, pipe_microbatches=2)
+        t.fit(windows)
+        for e, g in zip(
+            jax.tree.leaves(jax.tree.map(np.asarray, dense.params)),
+            jax.tree.leaves(jax.tree.map(np.asarray, t.params)),
+            strict=True,
+        ):
+            np.testing.assert_allclose(e, g, rtol=2e-3, atol=2e-4)
+
+    def test_nan_guard_skips_chaos_step(self, monkeypatch):
+        """A chaos-poisoned step under the 1F1B engine is skipped on
+        device and counted — the guard composes with the pipeline's
+        custom_vjp gradients."""
+        from tpu_dist.resilience import chaos
+
+        monkeypatch.setenv(chaos.ENV_VAR, "nan_step=1")  # 2nd of 2 steps
+        mesh = comm.make_mesh((1, 2), ("data", "pipe"), platform="cpu")
+        t = _pipe_trainer(mesh, nan_guard=True)
+        hist = t.fit(_windows())
+        assert hist[-1].bad_steps == 1
+        assert np.isfinite(
+            np.asarray(jax.tree.leaves(t.params)[0])
+        ).all()
+
+    def test_pipelined_dispatch_matches_sync(self):
+        """K-deep `PipelineDriver` dispatch over the 1F1B step: drain()
+        drains the pipe — results bit-identical at any depth."""
+        windows = _windows()
+
+        def final_params(k):
+            mesh = comm.make_mesh((1, 2), ("data", "pipe"), platform="cpu")
+            t = _pipe_trainer(mesh, inflight_steps=k)
+            hist = t.fit(windows)
+            return [np.asarray(a) for a in jax.tree.leaves(t.params)], hist
+
+        ref, ref_hist = final_params(0)
+        got, hist = final_params(2)
+        assert [h.mean_loss for h in hist] == [
+            h.mean_loss for h in ref_hist
+        ]
+        for a, b in zip(ref, got, strict=True):
+            np.testing.assert_array_equal(a, b)
+
+    def test_bubble_fraction_in_telemetry(self, tmp_path, monkeypatch):
+        """Step and epoch events carry the MEASURED schedule bubble; the
+        event files stay schema-valid."""
+        from tpu_dist.observe import events as ev
+
+        monkeypatch.setenv(ev.ENV_DIR, str(tmp_path))
+        mesh = comm.make_mesh((1, 2), ("data", "pipe"), platform="cpu")
+        t = _pipe_trainer(mesh)
+        expect = t._pipe_summary["bubble_fraction"]
+        assert expect == pytest.approx(
+            build_schedule(2, 4, 2, "interleaved_1f1b").bubble_fraction(),
+            abs=1e-6,
+        )
+        t.fit(_windows())
+        count, errors = ev.validate_dir(str(tmp_path))
+        assert count and not errors, errors
+        recs = ev.read_events(str(tmp_path))
+        steps = [r for r in recs if r["event"] == "step"]
+        epochs = [r for r in recs if r["event"] == "epoch"]
+        assert steps and epochs
+        assert all(
+            r["bubble_fraction"] == pytest.approx(expect) for r in steps
+        )
+        assert epochs[-1]["bubble_fraction"] == pytest.approx(expect)
+        assert epochs[-1]["goodput"]["bubble_fraction"] == pytest.approx(
+            expect
+        )
+        assert epochs[-1]["pipeline"]["kind"] == "interleaved_1f1b"
+
+    def test_bad_schedule_fails_at_config_time(self):
+        """interleaved microbatch constraint violations surface when the
+        trainer is BUILT, not at first trace."""
+        mesh = comm.make_mesh((1, 2), ("data", "pipe"), platform="cpu")
+        cfg = train.LMTrainConfig(
+            epochs=1, global_batch=GB, pipeline="1f1b",
+            pipe_microbatches=3, pipe_interleave=2, log=lambda *_: None,
+        )
+        with pytest.raises(ValueError, match="multiple"):
+            train.LMTrainer(_lm(), mesh, cfg)

@@ -141,6 +141,9 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
             if hbm.get("bytes_in_use")
             else "--"
         )
+        # pipeline runs only: the measured schedule bubble fraction
+        bubble = s.get("bubble_fraction")
+        bubble_s = f"  bubble {_fmt(bubble, '.1%')}" if bubble is not None else ""
         lines.append(
             f"rank {rank}  step {_fmt(s.get('step'))}"
             f"  epoch {_fmt(s.get('epoch'))}"
@@ -151,6 +154,7 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
             f"  bad {_fmt(s.get('bad_steps'))}"
             f"  scale {_fmt(s.get('loss_scale'))}"
             f"  hbm {hbm_s}"
+            f"{bubble_s}"
             f"  ({_age(s.get('time'), now)})"
         )
     if not state["steps"]:
@@ -159,10 +163,12 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
     if state["epochs"]:
         e = state["epochs"][-1]
         g = (e.get("goodput") or {}).get("goodput")
+        eb = e.get("bubble_fraction")
         lines.append(
             f"epoch {_fmt(e.get('epoch'))}: mean loss "
             f"{_fmt(e.get('mean_loss'), '.4f')}  "
             f"{_fmt(e.get('seconds'), '.1f')}s  goodput {_fmt(g, '.1%')}"
+            + (f"  bubble {_fmt(eb, '.1%')}" if eb is not None else "")
         )
 
     if state["beats"]:

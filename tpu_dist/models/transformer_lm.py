@@ -716,13 +716,18 @@ class TransformerLM(Module):
     def loss_pipeline(
         self, params, tokens, axis_name, *,
         n_microbatches: int = 4, interleave: int = 1,
+        engine: bool = False, remat_stages: bool = False,
+        schedule_kind: str | None = None,
     ):
         """Pipeline-parallel TRAINING loss for use INSIDE shard_map over
         a ``pipe`` axis (`parallel.make_stateful_train_step` with
         ``grad_psum_axes=(axis_name,)``).
 
-        Gradient contract: the psum over ``axis_name`` of the per-rank
-        grad pytrees equals the dense `lm_loss` gradient (tested).  The
+        ``engine=False`` (the GPipe-era path): forward-only scheduling
+        through `apply_pipeline`; autodiff replays the schedule scan in
+        reverse, so activation memory is O(M) scan residuals.  Gradient
+        contract: the psum over ``axis_name`` of the per-rank grad
+        pytrees equals the dense `lm_loss` gradient (tested).  The
         pieces: block grads land only on the rank owning each stage
         (`parallel.pipeline_apply`'s convention — summing recovers the
         sequential grads); the embedding-lookup/positional grads land
@@ -731,7 +736,19 @@ class TransformerLM(Module):
         their differentiable path scaled 1/n (forward value unchanged)
         — n identical head grads then psum back to exactly the dense
         grad, and the weight-tied embedding table gets its lookup and
-        head contributions each counted once."""
+        head contributions each counted once.
+
+        ``engine=True`` routes through the schedule-driven TRUE 1F1B
+        executor instead (`loss_pipeline_1f1b`): backward ticks
+        interleave with forward ticks, activation stash O(n·v) not
+        O(M).  Same psum gradient contract (tested against this path
+        and against dense)."""
+        if engine:
+            return self.loss_pipeline_1f1b(
+                params, tokens, axis_name,
+                n_microbatches=n_microbatches, interleave=interleave,
+                remat_stages=remat_stages, schedule_kind=schedule_kind,
+            )
         from jax import lax
 
         n = lax.axis_size(axis_name)
@@ -749,6 +766,86 @@ class TransformerLM(Module):
             head_params=head,
         )
         return lm_loss(logits.astype(jnp.float32), tokens)
+
+    def loss_pipeline_1f1b(
+        self, params, tokens, axis_name, *,
+        n_microbatches: int = 4, interleave: int = 1,
+        remat_stages: bool = False, schedule_kind: str | None = None,
+    ):
+        """TRUE 1F1B pipeline training loss — the schedule-driven engine
+        (`parallel.pipeline_engine_loss`) for use INSIDE shard_map over
+        a ``pipe`` axis.
+
+        Stage split matches `apply_pipeline` exactly (rank r, chunk c =
+        global stage ``c·n + r`` of ``depth/(n·v)`` consecutive blocks;
+        the embedding trunk runs replicated up front), but the loss is
+        computed PER MICROBATCH on the last global stage, whose backward
+        starts the tick after that microbatch's forward — forwards and
+        backwards interleave tick-for-tick and the activation stash
+        holds O(n·v) stage inputs instead of O(M) scan residuals.
+
+        Gradient contract (psum over ``axis_name`` equals the dense
+        `lm_loss` gradient, tested): chunk-block grads land on the
+        owning rank, the LN/vocab-head grads land on rank n-1 (the only
+        rank that runs the head), and the embedding-lookup/positional
+        grads land on rank 0 via the engine's trunk cotangent — each
+        contribution counted exactly once, no replicated-head 1/n
+        scaling needed.
+
+        ``schedule_kind`` overrides the schedule table (default:
+        ``'interleaved_1f1b'`` when ``interleave > 1`` else ``'1f1b'``;
+        ``'gpipe'`` gives the flush schedule with the O(M) stash —
+        useful for measuring what 1F1B buys)."""
+        from jax import lax
+
+        from tpu_dist.parallel.pipeline import (
+            build_schedule,
+            default_schedule_kind,
+            pipeline_engine_loss,
+        )
+        from tpu_dist.utils.tree import stack_pytrees
+
+        n = lax.axis_size(axis_name)
+        r = lax.axis_index(axis_name)
+        v = interleave
+        depth = len(self.blocks)
+        if depth % (n * v):
+            raise ValueError(
+                f"depth {depth} not divisible by pipeline world {n} x "
+                f"interleave {v}"
+            )
+        pc = depth // (n * v)
+        stacked = stack_pytrees(params["blocks"])
+        chunks = [
+            jax.tree.map(
+                lambda t: lax.dynamic_slice_in_dim(t, (c * n + r) * pc, pc, 0),
+                stacked,
+            )
+            for c in range(v)
+        ]
+        chunks_local = stack_pytrees(chunks)
+        blk = self.blocks[0]  # stages share the block architecture
+
+        def stage_fn(chunk_params, a):
+            for i in range(pc):
+                pb = jax.tree.map(lambda t: t[i], chunk_params)
+                a, _ = blk.apply(pb, {}, a)
+            return a
+
+        def last_fn(chunk_params, head, x_in, tok_mb):
+            y = stage_fn(chunk_params, x_in)
+            ln_p, table = head
+            y, _ = self.ln.apply(ln_p, {}, y)
+            return lm_loss((y @ table.T).astype(jnp.float32), tok_mb)
+
+        kind = schedule_kind or default_schedule_kind(v)
+        sched = build_schedule(n, n_microbatches, v, kind)
+        h = self._trunk(params, tokens)
+        return pipeline_engine_loss(
+            stage_fn, last_fn, sched, chunks_local,
+            (params["ln"], params["embed"]["table"]), h, tokens,
+            axis_name=axis_name, remat_stages=remat_stages,
+        )
 
     def apply_moe_ep(self, params, tokens_local, axis_name):
         """Expert-parallel forward for use INSIDE shard_map: the batch

@@ -56,11 +56,14 @@ STEP_REQUIRED = (
     "bad_steps",
     "loss_scale",
     "hbm",
+    # pipeline-parallel runs: measured schedule-table idle fraction
+    # (null when the step is not pipeline-scheduled)
+    "bubble_fraction",
 )
 SCHEMA: dict[str, tuple[str, ...]] = {
     "manifest": ("world", "platform", "mesh", "config"),
     "step": STEP_REQUIRED,
-    "epoch": ("epoch", "mean_loss", "seconds", "goodput"),
+    "epoch": ("epoch", "mean_loss", "seconds", "goodput", "bubble_fraction"),
     "checkpoint": ("path", "epoch", "seconds"),
     "retry": ("what", "attempt", "max_attempts", "error"),
     "chaos": ("clause",),

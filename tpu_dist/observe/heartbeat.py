@@ -225,6 +225,16 @@ class GoodputMeter:
     def __init__(self):
         self.seconds: dict[str, float] = {}
         self.phase_seconds: dict[str, float] = {}
+        self.bubble_fraction: float | None = None
+
+    def set_bubble_fraction(self, fraction: float | None) -> None:
+        """Attach the pipeline schedule's measured idle fraction — a
+        THIRD axis like phases: the bubble decomposes productive time
+        (devices idle inside a scheduled step), it does not compete with
+        the category total.  None = not a pipeline run."""
+        self.bubble_fraction = (
+            None if fraction is None else float(fraction)
+        )
 
     def account(self, category: str, seconds: float) -> None:
         self.seconds[category] = self.seconds.get(category, 0.0) + float(seconds)
@@ -258,7 +268,7 @@ class GoodputMeter:
 
     def summary(self) -> dict:
         g = self.goodput()
-        return {
+        out = {
             "seconds": {k: round(v, 4) for k, v in sorted(self.seconds.items())},
             "phases": {
                 k: round(v, 4) for k, v in sorted(self.phase_seconds.items())
@@ -266,3 +276,6 @@ class GoodputMeter:
             "total_s": round(self.total(), 4),
             "goodput": round(g, 4) if g is not None else None,
         }
+        if self.bubble_fraction is not None:
+            out["bubble_fraction"] = round(self.bubble_fraction, 6)
+        return out

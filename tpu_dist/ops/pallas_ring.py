@@ -78,6 +78,15 @@ def _ring_kernel(x_ref, o_ref, comm_buf, send_sem, recv_sem, *, axis_name):
     lax.fori_loop(0, n - 1, step_body, None)
 
 
+def tpu_interpret_supported() -> bool:
+    """Whether this jax ships Pallas's TPU interpret simulator
+    (`pltpu.InterpretParams`, jax >= 0.5) — the mode that simulates DMA
+    semaphores and remote copies on CPU devices.  Older jax only has the
+    generic HLO interpreter, which cannot execute the inter-chip RDMA
+    primitives this kernel is made of."""
+    return hasattr(pltpu, "InterpretParams")
+
+
 def _pallas_ring(
     x: jax.Array, axis_name: str, collective_id: int, *,
     interpret: bool = False,
@@ -86,7 +95,16 @@ def _pallas_ring(
     mode (`pltpu.InterpretParams`), which SIMULATES the semaphores and
     inter-chip RDMAs on CPU devices — the same kernel body, exercised
     without hardware (tests/test_ops.py runs it on the CPU-sim mesh and
-    cross-checks against psum)."""
+    cross-checks against psum).  Raises `NotImplementedError` on jax
+    builds without the simulator (see `tpu_interpret_supported`) rather
+    than tripping an AttributeError mid-trace."""
+    if interpret and not tpu_interpret_supported():
+        raise NotImplementedError(
+            "Pallas TPU interpret mode (pltpu.InterpretParams) is not "
+            f"available in jax {jax.__version__}; the RDMA ring kernel "
+            "can only be simulated on jax >= 0.5 (compiled execution "
+            "still needs >= 2 real TPU chips)"
+        )
     return pl.pallas_call(
         functools.partial(_ring_kernel, axis_name=axis_name),
         out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),

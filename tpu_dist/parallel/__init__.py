@@ -28,12 +28,16 @@ from tpu_dist.parallel.moe import (
 )
 from tpu_dist.parallel.pipeline import (
     PIPE_AXIS,
+    SCHEDULE_KINDS,
+    Schedule,
+    build_schedule,
     gpipe_bubble_fraction,
     gpipe_ticks,
     interleaved_bubble_fraction,
     interleaved_ticks,
     pipeline_apply,
     pipeline_apply_interleaved,
+    pipeline_engine_loss,
     stack_chunk_params,
     stack_stage_params,
 )
@@ -93,6 +97,10 @@ __all__ = [
     "moe_mlp_top2",
     "pipeline_apply",
     "pipeline_apply_interleaved",
+    "pipeline_engine_loss",
+    "Schedule",
+    "SCHEDULE_KINDS",
+    "build_schedule",
     "stack_chunk_params",
     "stack_expert_params",
     "stack_stage_params",

@@ -24,7 +24,11 @@ it composes with `jax.grad`/train steps — tested.
 
 from __future__ import annotations
 
+import dataclasses
+import heapq
 from typing import Any, Callable
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +37,20 @@ from jax import lax
 from tpu_dist.comm.collectives import ring_perm
 
 PIPE_AXIS = "pipe"
+
+# Schedule-table op codes (`Schedule.ops` cells; also the `lax.switch`
+# branch indices in the engine executor).
+IDLE, FWD, BWD = 0, 1, 2
+
+SCHEDULE_KINDS = ("gpipe", "1f1b", "interleaved_1f1b")
+
+
+def default_schedule_kind(n_chunks: int) -> str:
+    """The 1F1B schedule kind for a chunk count — the ONE place the
+    v>1 → interleaved default lives (trainer and model both call it, so
+    the telemetry table and the executed table can never disagree on
+    the default)."""
+    return "interleaved_1f1b" if n_chunks > 1 else "1f1b"
 
 
 def stack_stage_params(stage_params: list[Any]) -> Any:
@@ -267,3 +285,548 @@ def pipeline_apply_interleaved(
     # Same replicated-cotangent correction as `pipeline_apply`.
     outputs = outputs / n + lax.stop_gradient(outputs * (n - 1) / n)
     return outputs.reshape((B,) + x.shape[1:])
+
+
+# ===================================================================
+# Schedule-driven pipeline engine: a static schedule table (build once
+# on the host) + one `lax.scan` executor that interleaves forward and
+# backward ticks — TRUE 1F1B.  The scan-replay paths above schedule
+# forwards only and let autodiff replay the whole scan in reverse, so
+# their activation memory is O(M) microbatch residuals and no backward
+# ever overlaps a forward.  The engine below runs the textbook
+# schedules: forward ticks push the stage INPUT into a fixed-depth
+# ring stash, backward ticks pop it, recompute the stage forward under
+# `jax.vjp`, and flow the cotangent through the reverse ppermute ring
+# — steady-state activation memory O(n·v), bubble (n-1)/(M·v+n-1).
+# ===================================================================
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """A compiled pipeline schedule: per-tick op tables plus the ring-
+    buffer slot assignments the executor needs, all static numpy.
+
+    Every array is ``(ticks, n)`` indexed ``[t, rank]``:
+
+    - ``ops``: IDLE / FWD / BWD (the `lax.switch` branch per tick)
+    - ``chunk`` / ``mb``: which (virtual-stage chunk, microbatch) the op
+      touches (0 where idle — masked, never read)
+    - ``stash_push`` / ``stash_pop``: activation-stash slot a FWD writes
+      its stage input to / a BWD pops (-1 = none)
+    - ``fwd_read``: slot of MY fwd ring buffer a FWD consumes its input
+      from (-1 = global stage 0, which injects the trunk microbatch)
+    - ``bwd_read``: slot of MY bwd ring buffer a BWD takes its incoming
+      cotangent from (-1 = last global stage, which seeds from the loss)
+    - ``fwd_write`` / ``bwd_write``: slot of MY ring buffer where the
+      payload ARRIVING at the end of tick t lands (-1 = drop — the
+      neighbor sent garbage or an unconsumed wrap-around)
+
+    Depths are the simulated high-water marks — the bounded-ring sizes
+    the executor allocates.  ``stash_depth`` is the memory story: O(n·v)
+    for the 1F1B kinds, M for GPipe.
+    """
+
+    kind: str
+    n: int
+    n_microbatches: int
+    n_chunks: int
+    ops: np.ndarray
+    chunk: np.ndarray
+    mb: np.ndarray
+    stash_push: np.ndarray
+    stash_pop: np.ndarray
+    fwd_read: np.ndarray
+    bwd_read: np.ndarray
+    fwd_write: np.ndarray
+    bwd_write: np.ndarray
+    stash_depth: int
+    fwd_depth: int
+    bwd_depth: int
+
+    @property
+    def ticks(self) -> int:
+        return int(self.ops.shape[0])
+
+    def bubble_fraction(self) -> float:
+        """MEASURED idle fraction of this table: idle cells over all
+        (tick, rank) cells — what the executor will actually burn, as
+        opposed to the closed-form `gpipe_bubble_fraction` /
+        `interleaved_bubble_fraction` estimates."""
+        return float((self.ops == IDLE).mean())
+
+    def stash_high_water(self) -> int:
+        """Peak live activation-stash entries on any rank (in microbatch
+        activations).  The 1F1B acceptance number: O(n·v), not O(M)."""
+        return self.stash_depth
+
+    def work_cells(self) -> int:
+        return int((self.ops != IDLE).sum())
+
+
+def _op_order(kind: str, n: int, M: int, v: int, s: int):
+    """Rank ``s``'s op sequence [(op, chunk, mb), ...] — the per-rank
+    HALF of the schedule; `build_schedule`'s greedy simulation assigns
+    the ticks."""
+    if kind == "gpipe":
+        # all forwards, flush, then backwards in reverse microbatch
+        # order (F(M-1) finishes last downstream, so B(M-1) unblocks
+        # first) — the GPipe memory shape: all M inputs stashed.
+        return [(FWD, 0, m) for m in range(M)] + [
+            (BWD, 0, m) for m in reversed(range(M))
+        ]
+    if kind == "1f1b":
+        w = min(n - 1 - s, M)  # classic warmup: deeper ranks start colder
+        order = [(FWD, 0, m) for m in range(w)]
+        for i in range(M - w):
+            order += [(FWD, 0, w + i), (BWD, 0, i)]
+        order += [(BWD, 0, i) for i in range(M - w, M)]
+        return order
+    # interleaved_1f1b: Megatron's virtual-stage order — microbatches in
+    # rounds of n, chunks cycled within each round (reversed for the
+    # backward half), warmup (n-1-s)·2 + (v-1)·n chunk-ops.
+    f_order = [
+        (c, r * n + j)
+        for r in range(M // n)
+        for c in range(v)
+        for j in range(n)
+    ]
+    b_order = [
+        (c, r * n + j)
+        for r in range(M // n)
+        for c in reversed(range(v))
+        for j in range(n)
+    ]
+    w = min((n - 1 - s) * 2 + (v - 1) * n, M * v)
+    order = [(FWD,) + f_order[i] for i in range(w)]
+    bi = 0
+    for fi in range(w, M * v):
+        order.append((FWD,) + f_order[fi])
+        order.append((BWD,) + b_order[bi])
+        bi += 1
+    order += [(BWD,) + b_order[i] for i in range(bi, M * v)]
+    return order
+
+
+def _ready(op, c, m, s, done_at, n, v):
+    """Can rank ``s`` fire (op, c, m) this tick?  Payloads produced at
+    tick t arrive at the start of tick t+1 (one ppermute hop), so a
+    dependency completed strictly BEFORE this tick is required."""
+    g = c * n + s  # global stage
+    if op == FWD:
+        if g == 0:
+            return True  # injects the trunk microbatch — always ready
+        ps, pc = (s - 1, c) if s > 0 else (n - 1, c - 1)
+        return (FWD, pc, m, ps) in done_at
+    if g == n * v - 1:
+        # last global stage seeds its own backward from the loss; only
+        # its OWN forward (the stashed input) gates it.
+        return (FWD, c, m, s) in done_at
+    ds, dc = (s + 1, c) if s < n - 1 else (0, c + 1)
+    return (BWD, dc, m, ds) in done_at
+
+
+def _alloc_slots(events, T):
+    """Bounded-ring slot allocation for one rank's buffer: ``events`` is
+    [(write_tick, read_tick, key)] — payload lands at the END of
+    write_tick, is consumed DURING read_tick (so a slot freed by a read
+    can take that same tick's arrival).  Returns (write_slot_by_tick,
+    read_slot_by_tick, depth)."""
+    writes_at: dict[int, tuple] = {}
+    reads_at: dict[int, tuple] = {}
+    for tw, tr, key in events:
+        assert tw not in writes_at and tr not in reads_at  # 1 op/tick/rank
+        writes_at[tw] = key
+        reads_at[tr] = key
+    w_slot = -np.ones(T, np.int32)
+    r_slot = -np.ones(T, np.int32)
+    free: list[int] = []
+    live: dict[tuple, int] = {}
+    n_alloc = 0
+    for t in range(T):
+        if t in reads_at:
+            slot = live.pop(reads_at[t])
+            r_slot[t] = slot
+            heapq.heappush(free, slot)
+        if t in writes_at:
+            slot = heapq.heappop(free) if free else n_alloc
+            if slot == n_alloc:
+                n_alloc += 1
+            live[writes_at[t]] = slot
+            w_slot[t] = slot
+    assert not live
+    return w_slot, r_slot, max(1, n_alloc)
+
+
+def build_schedule(
+    n: int, n_microbatches: int, n_chunks: int = 1, kind: str = "1f1b"
+) -> Schedule:
+    """Compile a pipeline schedule table for ``n`` ranks, ``M``
+    microbatches, and ``v`` chunks (virtual stages) per rank.
+
+    ``kind``: ``'gpipe'`` (flush: all forwards then all backwards, stash
+    grows to M), ``'1f1b'`` (one-forward-one-backward steady state,
+    stash ≤ n), or ``'interleaved_1f1b'`` (Megatron virtual stages,
+    stash O(n·v), drain bubble (n-1)/(M·v+n-1)).  Generation is a greedy
+    lockstep simulation: each rank executes its textbook op order
+    as-soon-as-ready (payloads arrive one tick after production), then
+    stash and neighbor ring-buffer slots are assigned from the simulated
+    lifetimes — so the executor's buffers are exactly as deep as the
+    schedule's true high-water mark, never M-sized for the 1F1B kinds.
+    """
+    M, v = int(n_microbatches), int(n_chunks)
+    if kind not in SCHEDULE_KINDS:
+        raise ValueError(f"kind must be one of {SCHEDULE_KINDS}, got {kind!r}")
+    if n < 1 or M < 1 or v < 1:
+        raise ValueError(f"need n, M, v >= 1, got {(n, M, v)}")
+    if kind in ("gpipe", "1f1b") and v != 1:
+        raise ValueError(f"{kind} schedules take n_chunks=1, got {v}")
+    if kind == "interleaved_1f1b":
+        if v == 1:
+            kind = "1f1b"  # v=1 interleaving IS the classic schedule
+        elif M % n:
+            raise ValueError(
+                f"interleaved_1f1b needs n_microbatches ({M}) to be a "
+                f"multiple of the pipe world ({n}) — rounds of n"
+            )
+
+    orders = [_op_order(kind, n, M, v, s) for s in range(n)]
+    ptr = [0] * n
+    done_at: dict[tuple, int] = {}
+    cols: list[list] = []
+    remaining = sum(len(o) for o in orders)
+    while remaining:
+        # readiness is evaluated for every rank against the PREVIOUS
+        # tick's completions before any of this tick's are committed
+        col = []
+        for s in range(n):
+            if ptr[s] >= len(orders[s]):
+                col.append(None)
+                continue
+            op, c, m = orders[s][ptr[s]]
+            col.append((op, c, m) if _ready(op, c, m, s, done_at, n, v) else None)
+        fired = [e for e in col if e is not None]
+        if not fired:
+            raise RuntimeError(
+                f"schedule deadlock: kind={kind} n={n} M={M} v={v} at "
+                f"tick {len(cols)}"
+            )
+        t = len(cols)
+        for s, e in enumerate(col):
+            if e is not None:
+                done_at[e + (s,)] = t
+                ptr[s] += 1
+                remaining -= 1
+        cols.append(col)
+    T = len(cols)
+
+    ops = np.zeros((T, n), np.int32)
+    chunk = np.zeros((T, n), np.int32)
+    mb = np.zeros((T, n), np.int32)
+    stash_push = -np.ones((T, n), np.int32)
+    stash_pop = -np.ones((T, n), np.int32)
+    fwd_read = -np.ones((T, n), np.int32)
+    bwd_read = -np.ones((T, n), np.int32)
+    fwd_write = -np.ones((T, n), np.int32)
+    bwd_write = -np.ones((T, n), np.int32)
+    for t, col in enumerate(cols):
+        for s, e in enumerate(col):
+            if e is None:
+                continue
+            op, c, m = e
+            ops[t, s], chunk[t, s], mb[t, s] = op, c, m
+
+    # Activation stash: FWD pushes its stage input, the SAME rank's BWD
+    # of the same (chunk, mb) pops it.
+    stash_depth = 1
+    for s in range(n):
+        events = []
+        for key, t in done_at.items():
+            op, c, m, rs = key
+            if rs != s or op != FWD:
+                continue
+            tb = done_at[(BWD, c, m, s)]
+            events.append((t, tb, (c, m)))
+        # pushes happen DURING the tick (not at its end), but a rank
+        # runs one op per tick so a push never collides with its own
+        # pop; the end-of-tick write model is equivalent here.
+        w, r, depth = _alloc_slots(events, T)
+        for t in range(T):
+            if w[t] >= 0:
+                stash_push[t, s] = w[t]
+            if r[t] >= 0:
+                stash_pop[t, s] = r[t]
+        stash_depth = max(stash_depth, depth)
+
+    # Neighbor ring buffers: a FWD at global stage g on rank ps lands in
+    # rank (ps+1)%n's fwd buffer at the end of its tick and is consumed
+    # by stage g+1's FWD; the last global stage's output has no consumer
+    # (dropped).  Cotangents mirror this leftward.
+    fwd_events: list[list] = [[] for _ in range(n)]
+    bwd_events: list[list] = [[] for _ in range(n)]
+    for key, t in done_at.items():
+        op, c, m, s = key
+        g = c * n + s
+        if op == FWD and g < n * v - 1:
+            cs, cc = (s + 1, c) if s < n - 1 else (0, c + 1)
+            tc = done_at[(FWD, cc, m, cs)]
+            fwd_events[cs].append((t, tc, (cc, m)))
+        elif op == BWD and g > 0:
+            cs, cc = (s - 1, c) if s > 0 else (n - 1, c - 1)
+            tc = done_at[(BWD, cc, m, cs)]
+            bwd_events[cs].append((t, tc, (cc, m)))
+    fwd_depth = bwd_depth = 1
+    for s in range(n):
+        w, r, depth = _alloc_slots(fwd_events[s], T)
+        fwd_write[:, s], fwd_depth = w, max(fwd_depth, depth)
+        for t in range(T):
+            if r[t] >= 0:
+                fwd_read[t, s] = r[t]
+        w, r, depth = _alloc_slots(bwd_events[s], T)
+        bwd_write[:, s], bwd_depth = w, max(bwd_depth, depth)
+        for t in range(T):
+            if r[t] >= 0:
+                bwd_read[t, s] = r[t]
+
+    return Schedule(
+        kind=kind, n=n, n_microbatches=M, n_chunks=v,
+        ops=ops, chunk=chunk, mb=mb,
+        stash_push=stash_push, stash_pop=stash_pop,
+        fwd_read=fwd_read, bwd_read=bwd_read,
+        fwd_write=fwd_write, bwd_write=bwd_write,
+        stash_depth=stash_depth, fwd_depth=fwd_depth, bwd_depth=bwd_depth,
+    )
+
+
+def _store_slot(buf: jax.Array, payload: jax.Array, slot) -> jax.Array:
+    """Write ``payload`` into ring-buffer ``buf`` at ``slot`` (traced
+    scalar); slot < 0 drops the payload."""
+    updated = lax.dynamic_update_index_in_dim(
+        buf, payload, jnp.maximum(slot, 0), 0
+    )
+    return jnp.where(slot >= 0, updated, buf)
+
+
+def _take_slot(buf: jax.Array, slot) -> jax.Array:
+    return lax.dynamic_index_in_dim(buf, jnp.maximum(slot, 0), 0, keepdims=False)
+
+
+def pipeline_engine_loss(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    last_fn: Callable[[Any, Any, jax.Array, Any], jax.Array],
+    schedule: Schedule,
+    chunks_local: Any,
+    head_params: Any,
+    h: jax.Array,
+    loss_args: Any,
+    *,
+    axis_name: str = PIPE_AXIS,
+    remat_stages: bool = False,
+) -> jax.Array:
+    """Schedule-driven pipeline TRAINING loss for use INSIDE shard_map
+    over ``axis_name`` — true fwd/bwd interleaving.
+
+    The executor runs ``schedule``'s table under ONE `lax.scan`: each
+    tick `lax.switch`es on the op code (idle / forward / backward),
+    forward ticks stash their stage input in the bounded ring, backward
+    ticks pop it and run the stage under `jax.vjp` (recompute-from-
+    input — stage-granular checkpointing is inherent, so the stash is
+    the ONLY schedule-lifetime activation memory), and both ppermute
+    rings fire every tick (receivers mask by the static slot tables).
+    The per-microbatch loss and its cotangent seed live on the LAST
+    global stage, which backpropagates ``last_fn`` (stage + head +
+    loss) the tick after that microbatch's forward — the 1F1B shape.
+
+    Exposed as a `jax.custom_vjp` scalar: ``jax.grad`` of the returned
+    loss works, with per-rank gradients following the pipeline psum
+    contract — chunk grads land on the owning rank, head grads on the
+    last rank, trunk cotangents (through ``h``) on rank 0 — so the psum
+    over ``axis_name`` equals sequential-execution gradients (tested).
+
+    Args:
+      stage_fn: ``(chunk_params, activation) -> activation``.
+      last_fn: ``(chunk_params, head_params, activation, loss_args_mb)
+        -> scalar`` — the LAST stage fused with the head and the
+        per-microbatch loss (mean over the microbatch).
+      schedule: a `build_schedule` table; ``schedule.n`` must equal the
+        ``axis_name`` mesh size.
+      chunks_local: this rank's chunk params, leading axis
+        ``schedule.n_chunks``.
+      head_params: pytree entering ``last_fn`` (replicated; grads land
+        on the last rank only).
+      h: the full local batch of stage-0 inputs ``(B, ...)``; split into
+        ``schedule.n_microbatches`` microbatches.
+      loss_args: pytree of per-example arrays (leading dim divisible by
+        M, e.g. target tokens), microbatched alongside ``h``.  Not
+        differentiated.
+
+    Returns the mean loss over microbatches, replicated on every rank.
+    """
+    n = lax.axis_size(axis_name)
+    if n != schedule.n:
+        raise ValueError(
+            f"schedule built for n={schedule.n} but {axis_name!r} axis "
+            f"has size {n}"
+        )
+    M, v = schedule.n_microbatches, schedule.n_chunks
+    chunk_leaves = jax.tree.leaves(chunks_local)
+    if chunk_leaves and chunk_leaves[0].shape[0] != v:
+        raise ValueError(
+            f"chunks_local leading axis {chunk_leaves[0].shape[0]} != "
+            f"schedule n_chunks {v}"
+        )
+    B = h.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    mb = B // M
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
+    s_idx = lax.axis_index(axis_name)
+    perm_right = ring_perm(n)
+    perm_left = [(i, (i - 1) % n) for i in range(n)]
+
+    def micro_split(a):
+        if a.shape[0] % M:
+            raise ValueError(
+                f"loss_args leading dim {a.shape[0]} not divisible by "
+                f"n_microbatches {M}"
+            )
+        return a.reshape((M, a.shape[0] // M) + a.shape[1:])
+
+    micro_args = jax.tree.map(micro_split, loss_args)
+    # This rank's (T,) schedule rows, sliced from the static tables.
+    rows = {
+        name: jnp.take(jnp.asarray(tbl), s_idx, axis=1)
+        for name, tbl in (
+            ("op", schedule.ops), ("chunk", schedule.chunk),
+            ("mb", schedule.mb),
+            ("stash_push", schedule.stash_push),
+            ("stash_pop", schedule.stash_pop),
+            ("fwd_read", schedule.fwd_read),
+            ("bwd_read", schedule.bwd_read),
+            ("fwd_write", schedule.fwd_write),
+            ("bwd_write", schedule.bwd_write),
+        )
+    }
+
+    def _run(chunks_local, head_params, h):
+        micro_h = h.reshape((M, mb) + h.shape[1:])
+        zero_act = jnp.zeros((mb,) + h.shape[1:], h.dtype)
+
+        def tick(carry, row):
+            fwd_buf, bwd_buf, stash, gacc, hacc, dh, lacc = carry
+            c, m = row["chunk"], row["mb"]
+            params_c = jax.tree.map(
+                lambda p: lax.dynamic_index_in_dim(p, c, 0, keepdims=False),
+                chunks_local,
+            )
+            args_m = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, m, 0, keepdims=False),
+                micro_args,
+            )
+            injects = (s_idx == 0) & (c == 0)   # global stage 0
+            is_last = (s_idx == n - 1) & (c == v - 1)
+
+            def idle_op(_):
+                return (
+                    zero_act, zero_act, stash, gacc, hacc, dh,
+                    jnp.float32(0.0),
+                )
+
+            def fwd_op(_):
+                x_buf = _take_slot(fwd_buf, row["fwd_read"])
+                h_m = lax.dynamic_index_in_dim(micro_h, m, 0, keepdims=False)
+                x_in = jnp.where(injects, h_m, x_buf)
+                y = stage_fn(params_c, x_in)
+                new_stash = lax.dynamic_update_index_in_dim(
+                    stash, x_in, jnp.maximum(row["stash_push"], 0), 0
+                )
+                return (
+                    y, zero_act, new_stash, gacc, hacc, dh, jnp.float32(0.0)
+                )
+
+            def bwd_op(_):
+                x_in = _take_slot(stash, row["stash_pop"])
+                g_in = _take_slot(bwd_buf, row["bwd_read"])
+
+                def last_case(_):
+                    lval, pull = jax.vjp(
+                        lambda pc, hp, xi: last_fn(pc, hp, xi, args_m),
+                        params_c, head_params, x_in,
+                    )
+                    dp, dhp, dx = pull(jnp.ones_like(lval))
+                    return lval.astype(jnp.float32), dp, dhp, dx
+
+                def mid_case(_):
+                    _, pull = jax.vjp(stage_fn, params_c, x_in)
+                    dp, dx = pull(g_in)
+                    zero_head = jax.tree.map(jnp.zeros_like, head_params)
+                    return jnp.float32(0.0), dp, zero_head, dx
+
+                lval, dp, dhp, dx = lax.cond(is_last, last_case, mid_case, None)
+
+                def add_chunk(acc, d):
+                    cur = lax.dynamic_index_in_dim(acc, c, 0, keepdims=False)
+                    return lax.dynamic_update_index_in_dim(acc, cur + d, c, 0)
+
+                new_gacc = jax.tree.map(add_chunk, gacc, dp)
+                new_hacc = jax.tree.map(jnp.add, hacc, dhp)
+                # global stage 0's input cotangent is the trunk's: bank
+                # it per microbatch (other ranks' dx rides the ring out)
+                cur = lax.dynamic_index_in_dim(dh, m, 0, keepdims=False)
+                upd = cur + jnp.where(injects, dx, jnp.zeros_like(dx))
+                new_dh = lax.dynamic_update_index_in_dim(dh, upd, m, 0)
+                return (
+                    zero_act, dx, stash, new_gacc, new_hacc, new_dh, lval
+                )
+
+            y_out, g_out, stash2, gacc2, hacc2, dh2, lval = lax.switch(
+                row["op"], [idle_op, fwd_op, bwd_op], None
+            )
+            # Both rings fire every tick (SPMD lockstep); the static
+            # write tables mask the garbage hops.
+            y_in = lax.ppermute(y_out, axis_name, perm_right)
+            g_arr = lax.ppermute(g_out, axis_name, perm_left)
+            fwd_buf2 = _store_slot(fwd_buf, y_in, row["fwd_write"])
+            bwd_buf2 = _store_slot(bwd_buf, g_arr, row["bwd_write"])
+            return (
+                fwd_buf2, bwd_buf2, stash2, gacc2, hacc2, dh2, lacc + lval
+            ), None
+
+        init = (
+            jnp.zeros((schedule.fwd_depth, mb) + h.shape[1:], h.dtype),
+            jnp.zeros((schedule.bwd_depth, mb) + h.shape[1:], h.dtype),
+            jnp.zeros((schedule.stash_depth, mb) + h.shape[1:], h.dtype),
+            jax.tree.map(jnp.zeros_like, chunks_local),
+            jax.tree.map(jnp.zeros_like, head_params),
+            jnp.zeros_like(micro_h),
+            jnp.float32(0.0),
+        )
+        (_, _, _, gacc, hacc, dh, lacc), _ = lax.scan(tick, init, rows)
+        # losses accumulate on the last rank only; mean over microbatches,
+        # replicated everywhere (the trainer's loss contract)
+        loss = lax.psum(lacc, axis_name) / M
+        inv = 1.0 / M  # seeds were 1.0 per microbatch; grads are of sum
+        scale = lambda t: jax.tree.map(  # noqa: E731
+            lambda a: (a * inv).astype(a.dtype), t
+        )
+        return loss, (scale(gacc), scale(hacc), (dh * inv).reshape(h.shape))
+
+    # custom_vjp boundary: the forward pass already computed the exact
+    # gradients (that is what interleaved BWD ticks ARE), so autodiff
+    # just scales them by the incoming loss cotangent.
+    @jax.custom_vjp
+    def engine(chunks_local, head_params, h):
+        return _run(chunks_local, head_params, h)[0]
+
+    def engine_fwd(chunks_local, head_params, h):
+        return _run(chunks_local, head_params, h)
+
+    def engine_bwd(grads, g):
+        dchunks, dhead, dh = grads
+        scale = lambda t: jax.tree.map(  # noqa: E731
+            lambda a: (a * g).astype(a.dtype), t
+        )
+        return scale(dchunks), scale(dhead), (dh * g).astype(dh.dtype)
+
+    engine.defvjp(engine_fwd, engine_bwd)
+    return engine(chunks_local, head_params, h)

@@ -69,12 +69,17 @@ class LMTrainConfig:
     sequence_parallel: str | None = None
     seq_axis: str = "seq"
     # Pipeline-parallel training over a (data x pipe) mesh: "gpipe" =
-    # the GPipe microbatch schedule, "1f1b" = the interleaved Megatron
-    # schedule with `pipe_interleave` chunks per rank.  Blocks are
-    # staged over the pipe axis inside the compiled step
-    # (`TransformerLM.loss_pipeline`, grads psum'd over 'pipe'); params
-    # replicated, so checkpoints/eval/generate are unchanged.  Mutually
-    # exclusive with the other model-sharding modes.
+    # the GPipe microbatch schedule (forward-only scheduling, autodiff
+    # replays the scan — O(M) activation residuals), "1f1b" = the TRUE
+    # 1F1B schedule-driven engine (`parallel.pipeline_engine_loss`):
+    # backward ticks interleave with forward ticks, the activation
+    # stash is O(n·v) with `pipe_interleave` virtual-stage chunks per
+    # rank, and the measured schedule bubble fraction is reported per
+    # step through telemetry.  Blocks are staged over the pipe axis
+    # inside the compiled step (`TransformerLM.loss_pipeline`, grads
+    # psum'd over 'pipe'); params replicated, so checkpoints/eval/
+    # generate are unchanged.  Mutually exclusive with the other
+    # model-sharding modes.
     pipeline: str | None = None
     pipe_axis: str = "pipe"
     pipe_microbatches: int = 4
@@ -241,6 +246,7 @@ class LMTrainer:
                     f"sequence_parallel needs a {self.config.seq_axis!r} "
                     f"mesh axis; mesh has {mesh.axis_names}"
                 )
+        self._pipe_schedule = None
         if pp is not None:
             if pp not in ("gpipe", "1f1b"):
                 raise ValueError(
@@ -256,6 +262,24 @@ class LMTrainer:
                     f"pipeline needs a {self.config.pipe_axis!r} mesh "
                     f"axis; mesh has {mesh.axis_names}"
                 )
+            from tpu_dist.parallel.pipeline import (
+                build_schedule,
+                default_schedule_kind,
+            )
+
+            n_pipe = int(mesh.shape[self.config.pipe_axis])
+            v = self.config.pipe_interleave if pp == "1f1b" else 1
+            kind = "gpipe" if pp == "gpipe" else default_schedule_kind(v)
+            # Built here for two reasons: a bad (n, M, v) combination
+            # fails at CONFIG time (not at trace time), and the table's
+            # measured bubble fraction feeds the per-step telemetry.
+            # The gpipe trainer path still executes via the scan-replay
+            # `apply_pipeline` (kept until engine parity is the default
+            # everywhere); its table has the identical tick structure,
+            # so the reported bubble is the executed one either way.
+            self._pipe_schedule = build_schedule(
+                n_pipe, self.config.pipe_microbatches, v, kind
+            )
         params, _ = lm.init(jax.random.key(self.config.seed))
         from tpu_dist.utils.debug import assert_no_aliasing
 
@@ -299,11 +323,21 @@ class LMTrainer:
                     logits.astype(jnp.float32), tokens, self.config.seq_axis
                 )
             if pp is not None:
+                # "1f1b" = the schedule-driven engine (true backward
+                # interleaving); "gpipe" = the scan-replay path.  The
+                # engine re-executes the SAME table the trainer built
+                # at config time (kind threaded through, so the
+                # telemetry bubble always describes the executed
+                # schedule).
                 return self.lm.loss_pipeline(
                     cast(p), tokens, self.config.pipe_axis,
                     n_microbatches=self.config.pipe_microbatches,
                     interleave=(
                         self.config.pipe_interleave if pp == "1f1b" else 1
+                    ),
+                    engine=(pp == "1f1b"),
+                    schedule_kind=(
+                        self._pipe_schedule.kind if pp == "1f1b" else None
                     ),
                 )
             if moe:
@@ -396,6 +430,20 @@ class LMTrainer:
                 grad_compress=self._compress,
             )
         self._model_state = parallel.replicate({}, mesh)
+        # Pipeline-schedule accounting for telemetry (static per step):
+        # the measured bubble fraction of the executed table.
+        self._pipe_summary = None
+        if self._pipe_schedule is not None:
+            sched = self._pipe_schedule
+            self._pipe_summary = {
+                "kind": sched.kind,
+                "n": sched.n,
+                "microbatches": sched.n_microbatches,
+                "chunks": sched.n_chunks,
+                "ticks": sched.ticks,
+                "bubble_fraction": round(sched.bubble_fraction(), 6),
+                "stash_depth": sched.stash_depth,
+            }
         # Wire accounting for telemetry (static per step): what the
         # compressed sync ships vs what exact fp32 would.
         self._compress_summary = None
@@ -447,6 +495,7 @@ class LMTrainer:
             world=self.world, mesh=self.mesh, config=cfg, trainer="LMTrainer"
         )
         telemetry.set_compress(self._compress_summary)
+        telemetry.set_pipeline(self._pipe_summary)
         ok = False
         try:
             history = self._fit_loop(

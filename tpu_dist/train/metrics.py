@@ -165,6 +165,11 @@ class TrainTelemetry:
             "relative quantization error of the last compressed sync",
         )
         self._compress_summary: dict | None = None
+        self._pipe_summary: dict | None = None
+        self._bubble_g = reg.gauge(
+            "tpu_dist_bubble_fraction",
+            "measured pipeline-schedule idle fraction (0 when not pipelined)",
+        )
         self._every = observe.events.step_every()
         self.world = world
         self.global_step = 0
@@ -381,6 +386,7 @@ class TrainTelemetry:
             bad_steps=bad,
             loss_scale=scale,
             hbm=device_memory_stats(),
+            bubble_fraction=self.bubble_fraction,
             **extra,
         )
 
@@ -389,6 +395,26 @@ class TrainTelemetry:
         `comm.compress.FlatPlan.wire_summary` dict (None = sync is
         uncompressed; all compress telemetry stays silent)."""
         self._compress_summary = summary
+
+    def set_pipeline(self, summary: dict | None) -> None:
+        """Arm pipeline-schedule accounting: ``summary`` carries the
+        executed schedule table's numbers (``kind``, ``ticks``,
+        ``stash_depth``, and the MEASURED ``bubble_fraction`` — idle
+        cells over all (tick, rank) cells).  None = the run is not
+        pipeline-parallel; step/epoch events then carry
+        ``bubble_fraction: null``."""
+        self._pipe_summary = summary
+        if summary is not None:
+            self._bubble_g.set(summary["bubble_fraction"])
+            self.goodput.set_bubble_fraction(summary["bubble_fraction"])
+
+    @property
+    def bubble_fraction(self) -> float | None:
+        """Measured schedule bubble of the active pipeline run (None
+        when not pipelined) — static per step, set once per fit."""
+        if self._pipe_summary is None:
+            return None
+        return self._pipe_summary["bubble_fraction"]
 
     def compress_done(self, *, error: float | None, epoch: int) -> None:
         """Per-epoch compressed-sync record: the `compression_error`
@@ -420,6 +446,8 @@ class TrainTelemetry:
                 mean_loss=mean_loss,
                 seconds=round(seconds, 4),
                 goodput=self.goodput.summary(),
+                bubble_fraction=self.bubble_fraction,
+                pipeline=self._pipe_summary,
                 **extra,
             )
 
