@@ -10,7 +10,7 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
         chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
-        bench-pipeline
+        bench-pipeline bench-decode bench-serve serve-demo
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -78,6 +78,14 @@ kernels:
 
 decode:
 	$(PY) benchmarks/decode.py --platform $(PLATFORM)
+
+bench-decode: decode  # alias: the persisted-results decode bench
+
+bench-serve:  # continuous vs static batching under seeded Poisson load
+	$(PY) benchmarks/serve.py --platform $(PLATFORM)
+
+serve-demo:  # engine on CPU-sim; asserts request events validate
+	cd demos && $(PY) serve_demo.py --platform $(PLATFORM)
 
 lm-train:
 	$(PY) benchmarks/lm_train.py --platform $(PLATFORM)

@@ -163,14 +163,19 @@ def main():
             ),
             file=sys.stderr,
         )
-    print(json.dumps({
+    record = {
         "metric": "lm_decode_tokens_per_sec",
         "mode": args.mode,
         "platform": dev.platform,
         "model": f"dim{args.dim}xL{args.depth}h{args.heads}",
         "prompt": args.prompt, "steps": args.steps,
         "rows": rows,
-    }))
+    }
+    import bench
+
+    # durable trace, parity with grad_reduce.py / lm_train.py
+    bench.persist_event({"bench": "decode", **record})
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -132,3 +132,15 @@ def test_train_lm_modes_rejects_unknown_mode():
     )
     assert proc.returncode != 0
     assert "--mode must be one of" in proc.stderr
+
+
+def test_serve_demo_end_to_end():
+    """The make serve-demo path: engine on CPU-sim, mixed load with a
+    mid-stream cancel, request events schema-validated, pool drained."""
+    out = run_demo("serve_demo.py", "--platform", "cpu", "--steps", "120")
+    assert "greedy accuracy vs chain: 1.00" in out or \
+        "greedy accuracy vs chain: 0.9" in out
+    assert "cancelled request: reason=cancelled" in out
+    assert "expect used == 0" in out
+    assert "events validate" in out
+    assert "serve  step" in out  # tpu_top renders the serve line

@@ -1,0 +1,512 @@
+"""Continuous-batching decode server: paged KV + engine + sampling.
+
+The serving contracts under test:
+
+- paged-cache decode is TOKEN-IDENTICAL to the dense `generate`
+  (greedy, same seed) across block sizes and prefill chunkings —
+  continuous batching changes when a request computes, never what;
+- admission/eviction order is deterministic under a seeded trace;
+- the block pool never leaks (allocated == freed after drain) and
+  admission blocks (head-of-line) on pool exhaustion;
+- the server survives a mid-stream request cancel;
+- runtime-parameter sampling (`serve.sampling`) reproduces the static
+  sampler exactly for equal settings.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist import models, serve
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return models.TransformerLM(vocab=64, dim=32, depth=2, heads=4,
+                                max_seq=48)
+
+
+@pytest.fixture(scope="module")
+def lm_params(lm):
+    params, _ = lm.init(jax.random.key(7))
+    return params
+
+
+def _cfg(**kw):
+    base = dict(max_batch=4, block_size=8, num_blocks=64, max_seq=32,
+                prefill_chunk=8)
+    base.update(kw)
+    return serve.ServeConfig(**base)
+
+
+class TestBlockAllocator:
+    def test_alloc_free_roundtrip(self):
+        a = serve.BlockAllocator(8)
+        got = a.alloc(3)
+        assert got == [0, 1, 2] and a.used == 3
+        a.free(got)
+        assert a.used == 0 and a.available == 8
+
+    def test_exhaustion_returns_none(self):
+        a = serve.BlockAllocator(4)
+        assert a.alloc(5) is None
+        first = a.alloc(4)
+        assert a.alloc(1) is None
+        a.free(first[:1])
+        assert a.alloc(1) is not None
+
+    def test_double_free_raises(self):
+        a = serve.BlockAllocator(4)
+        blocks = a.alloc(2)
+        a.free(blocks)
+        with pytest.raises(ValueError, match="unallocated"):
+            a.free(blocks[:1])
+
+    def test_high_water(self):
+        a = serve.BlockAllocator(8)
+        x = a.alloc(5)
+        a.free(x)
+        a.alloc(2)
+        assert a.high_water == 5
+
+
+class TestPagedParity:
+    """Paged greedy decode bit-matches dense `generate`."""
+
+    @pytest.mark.parametrize("block_size", [4, 8, 16])
+    def test_greedy_matches_dense_across_block_sizes(
+        self, lm, lm_params, block_size
+    ):
+        prompts = models.synthetic_tokens(4, 6, 64, seed=3)
+        dense = np.asarray(lm.generate(lm_params, prompts, 10, cache_len=32))
+        eng = serve.ServeEngine(lm, lm_params, _cfg(block_size=block_size))
+        rids = [eng.submit(np.asarray(prompts[i]), 10) for i in range(4)]
+        res = eng.run_until_drained()
+        got = np.stack([res[r].tokens for r in rids])
+        np.testing.assert_array_equal(got, dense)
+
+    @pytest.mark.parametrize("chunk", [3, 5, 16])
+    def test_chunked_prefill_matches_dense(self, lm, lm_params, chunk):
+        """Prompt ingestion split into chunks of any size reproduces
+        the one-shot prefill's continuation."""
+        prompts = models.synthetic_tokens(3, 11, 64, seed=5)
+        dense = np.asarray(lm.generate(lm_params, prompts, 8, cache_len=32))
+        eng = serve.ServeEngine(
+            lm, lm_params, _cfg(prefill_chunk=chunk)
+        )
+        rids = [eng.submit(np.asarray(prompts[i]), 8) for i in range(3)]
+        res = eng.run_until_drained()
+        got = np.stack([res[r].tokens for r in rids])
+        np.testing.assert_array_equal(got, dense)
+
+    def test_greedy_matches_with_mixed_sampling_neighbors(
+        self, lm, lm_params
+    ):
+        """A greedy request sharing the batch with sampled requests
+        still bit-matches the dense decode (per-slot sampling params
+        cannot leak across slots)."""
+        prompts = models.synthetic_tokens(3, 6, 64, seed=9)
+        dense = np.asarray(lm.generate(lm_params, prompts, 10, cache_len=32))
+        eng = serve.ServeEngine(lm, lm_params, _cfg())
+        rid = eng.submit(np.asarray(prompts[0]), 10)
+        eng.submit(
+            np.asarray(prompts[1]), 10,
+            sampling=serve.SamplingParams(temperature=0.9, top_k=8, seed=4),
+        )
+        eng.submit(
+            np.asarray(prompts[2]), 10,
+            sampling=serve.SamplingParams(temperature=1.0, top_p=0.9,
+                                          seed=5),
+        )
+        res = eng.run_until_drained()
+        np.testing.assert_array_equal(res[rid].tokens, dense[0])
+
+    def test_gqa_rope_window_variants(self):
+        """GQA caches, rope positions, and the sliding-window band all
+        ride the paged path unchanged."""
+        prompts = models.synthetic_tokens(2, 6, 64, seed=2)
+        for kw in (
+            {"kv_heads": 2},
+            {"pos_embedding": "rope"},
+            {"sliding_window": 8},
+        ):
+            lm_v = models.TransformerLM(
+                vocab=64, dim=32, depth=2, heads=4, max_seq=48, **kw
+            )
+            params, _ = lm_v.init(jax.random.key(1))
+            dense = np.asarray(
+                lm_v.generate(params, prompts, 8, cache_len=32)
+            )
+            eng = serve.ServeEngine(lm_v, params, _cfg(max_batch=2))
+            rids = [eng.submit(np.asarray(prompts[i]), 8) for i in range(2)]
+            res = eng.run_until_drained()
+            got = np.stack([res[r].tokens for r in rids])
+            np.testing.assert_array_equal(got, dense, err_msg=str(kw))
+
+    def test_staggered_admission_matches_dense(self, lm, lm_params):
+        """Requests admitted into slots mid-flight (continuous
+        batching's whole point) still decode exactly like the dense
+        path — slot reuse cannot leak stale KV into a new request."""
+        prompts = models.synthetic_tokens(6, 6, 64, seed=11)
+        dense = np.asarray(lm.generate(lm_params, prompts, 8, cache_len=32))
+        eng = serve.ServeEngine(lm, lm_params, _cfg(max_batch=2))
+        rids = [eng.submit(np.asarray(prompts[i]), 8) for i in range(6)]
+        res = eng.run_until_drained()
+        got = np.stack([res[r].tokens for r in rids])
+        np.testing.assert_array_equal(got, dense)
+
+
+class TestEngineScheduling:
+    def test_deterministic_under_seeded_trace(self, lm, lm_params):
+        """Same trace, same engine config -> identical admission /
+        eviction audit and identical tokens, run to run."""
+
+        def run():
+            eng = serve.ServeEngine(lm, lm_params, _cfg(max_batch=2))
+            rng = np.random.default_rng(0)
+            for i in range(6):
+                plen = int(rng.integers(2, 7))
+                steps = int(rng.integers(2, 9))
+                prompt = models.synthetic_tokens(1, plen, 64, seed=i)[0]
+                temp = 0.0 if i % 2 else 0.8
+                eng.submit(
+                    np.asarray(prompt), steps,
+                    sampling=serve.SamplingParams(
+                        temperature=temp, top_k=8, seed=i
+                    ),
+                )
+            res = eng.run_until_drained()
+            toks = {r: res[r].tokens.tolist() for r in res}
+            return eng.audit, toks
+
+        audit1, toks1 = run()
+        audit2, toks2 = run()
+        assert audit1 == audit2
+        assert toks1 == toks2
+        kinds = [a[0] for a in audit1]
+        assert "admit" in kinds and "finish" in kinds
+
+    def test_pool_never_leaks_under_churn(self, lm, lm_params):
+        """allocated == freed after drain, across many admit/evict
+        cycles with mixed lengths (slots and blocks reused)."""
+        eng = serve.ServeEngine(
+            lm, lm_params, _cfg(max_batch=2, num_blocks=12)
+        )
+        rng = np.random.default_rng(1)
+        for i in range(10):
+            plen = int(rng.integers(1, 8))
+            eng.submit(
+                models.synthetic_tokens(1, plen, 64, seed=i)[0],
+                int(rng.integers(1, 10)),
+            )
+        res = eng.run_until_drained()
+        assert len(res) == 10
+        assert eng.allocator.used == 0
+        assert eng.allocator.available == 12
+        assert eng.allocator.high_water > 0
+
+    def test_admission_blocks_on_pool_exhaustion(self, lm, lm_params):
+        """num_blocks too small for two requests: the second stays
+        queued until the first frees its blocks (head-of-line, FIFO)."""
+        # each request needs ceil((6+10)/8) = 2 blocks; pool holds 2
+        eng = serve.ServeEngine(
+            lm, lm_params, _cfg(max_batch=4, num_blocks=2)
+        )
+        p = models.synthetic_tokens(2, 6, 64, seed=0)
+        r0 = eng.submit(np.asarray(p[0]), 10)
+        r1 = eng.submit(np.asarray(p[1]), 10)
+        eng.step()
+        admits = [a for a in eng.audit if a[0] == "admit"]
+        assert [a[1] for a in admits] == [r0]  # r1 waits on the pool
+        assert len(eng.queue) == 1
+        res = eng.run_until_drained()
+        admits = [a for a in eng.audit if a[0] == "admit"]
+        assert [a[1] for a in admits] == [r0, r1]
+        assert res[r1].tokens.size == 10
+        assert eng.allocator.used == 0
+
+    def test_oversized_request_rejected(self, lm, lm_params):
+        eng = serve.ServeEngine(lm, lm_params, _cfg())
+        with pytest.raises(ValueError, match="max_seq"):
+            eng.submit(np.zeros(30, np.int32), 10)  # 40 > 32
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit(np.zeros(0, np.int32), 4)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.submit(np.zeros(4, np.int32), 0)
+
+    def test_pool_impossible_request_rejected_not_livelocked(
+        self, lm, lm_params
+    ):
+        """A request needing more blocks than the whole pool must be
+        rejected at submit — queueing it would livelock the FIFO head
+        forever (no eviction can ever free enough)."""
+        eng = serve.ServeEngine(
+            lm, lm_params, _cfg(max_batch=4, num_blocks=2)
+        )
+        with pytest.raises(ValueError, match="KV blocks"):
+            eng.submit(np.zeros(10, np.int32), 20)  # needs 4 > 2
+        assert not eng.pending  # nothing queued
+
+    def test_warmup_compiles_both_decode_paths_silently(
+        self, lm, lm_params, tmp_path, monkeypatch
+    ):
+        """warmup() must trace the greedy AND sampled decode programs
+        (the first tempered request must not pay a compile inside the
+        serving loop) without emitting any telemetry — no lifecycle
+        events on disk, no TTFT/TPOT histogram samples."""
+        from tpu_dist.observe import events as ev_mod
+        from tpu_dist.observe.registry import REGISTRY
+
+        out = str(tmp_path / "warmup_events")
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", out)
+        ttft = REGISTRY.histogram("tpu_dist_serve_ttft_seconds")
+        tpot = REGISTRY.histogram("tpu_dist_serve_tpot_seconds")
+        before = (ttft.count(), tpot.count())
+        eng = serve.ServeEngine(lm, lm_params, _cfg())
+        eng.warmup()
+        assert eng._decode_fn_greedy._cache_size() == 1
+        assert eng._decode_fn._cache_size() == 1
+        assert (ttft.count(), tpot.count()) == before
+        assert not eng.results and not eng.audit
+        files = ev_mod.event_files(out)
+        recs = ev_mod.read_events(out) if files else []
+        assert not recs, recs[:3]
+        eng.events.close()
+
+    def test_stop_token_finishes_early(self, lm, lm_params):
+        prompt = models.synthetic_tokens(1, 5, 64, seed=3)[0]
+        free = np.asarray(
+            lm.generate(lm_params, prompt[None], 12, cache_len=32)
+        )[0]
+        stop = int(free[3])
+        first = int(np.nonzero(free == stop)[0][0])
+        eng = serve.ServeEngine(lm, lm_params, _cfg())
+        rid = eng.submit(np.asarray(prompt), 12, stop_token=stop)
+        res = eng.run_until_drained()
+        assert res[rid].finish_reason == "stop"
+        assert res[rid].tokens[-1] == stop
+        assert res[rid].tokens.size == first + 1  # trimmed at first stop
+        np.testing.assert_array_equal(res[rid].tokens, free[: first + 1])
+
+    def test_cancel_mid_stream(self, lm, lm_params):
+        """Cancelling an in-flight request frees its slot/blocks and
+        the engine keeps serving everyone else."""
+        prompts = models.synthetic_tokens(3, 5, 64, seed=6)
+        dense = np.asarray(lm.generate(lm_params, prompts, 10, cache_len=32))
+        eng = serve.ServeEngine(lm, lm_params, _cfg(max_batch=2))
+        victim = eng.submit(np.asarray(prompts[0]), 20)
+        keep = eng.submit(np.asarray(prompts[1]), 10)
+        for _ in range(4):
+            eng.step()
+        assert eng.cancel(victim)
+        late = eng.submit(np.asarray(prompts[2]), 10)
+        res = eng.run_until_drained()
+        assert res[victim].finish_reason == "cancelled"
+        assert 0 < res[victim].emitted < 20
+        # the cancelled prefix matches the dense decode
+        np.testing.assert_array_equal(
+            res[victim].tokens, dense[0][: res[victim].emitted]
+        )
+        np.testing.assert_array_equal(res[keep].tokens, dense[1])
+        np.testing.assert_array_equal(res[late].tokens, dense[2])
+        assert eng.allocator.used == 0
+
+    def test_cancel_queued_and_unknown(self, lm, lm_params):
+        eng = serve.ServeEngine(
+            lm, lm_params, _cfg(max_batch=1)
+        )
+        r0 = eng.submit(models.synthetic_tokens(1, 4, 64)[0], 4)
+        r1 = eng.submit(models.synthetic_tokens(1, 4, 64)[0], 4)
+        assert eng.cancel(r1)  # still queued
+        assert not eng.cancel(999)
+        res = eng.run_until_drained()
+        assert res[r1].finish_reason == "cancelled"
+        assert res[r1].emitted == 0
+        assert res[r0].emitted == 4
+
+    def test_sampled_stream_is_scheduling_independent(self, lm, lm_params):
+        """A sampled request's tokens depend only on (seed, token
+        index) — not on which slot it lands in or who shares the
+        batch."""
+        prompts = models.synthetic_tokens(3, 6, 64, seed=8)
+        sp = serve.SamplingParams(temperature=0.9, top_k=8, seed=5)
+        eng1 = serve.ServeEngine(lm, lm_params, _cfg())
+        r1 = eng1.submit(np.asarray(prompts[1]), 10, sampling=sp)
+        eng1.submit(np.asarray(prompts[0]), 10)
+        res1 = eng1.run_until_drained()
+        eng2 = serve.ServeEngine(lm, lm_params, _cfg())
+        eng2.submit(np.asarray(prompts[2]), 3)
+        eng2.submit(np.asarray(prompts[0]), 7)
+        r2 = eng2.submit(np.asarray(prompts[1]), 10, sampling=sp)
+        res2 = eng2.run_until_drained()
+        np.testing.assert_array_equal(res1[r1].tokens, res2[r2].tokens)
+
+    def test_latency_fields_with_fake_clock(self, lm, lm_params):
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.5
+            return t[0]
+
+        eng = serve.ServeEngine(lm, lm_params, _cfg(), now=clock)
+        rid = eng.submit(models.synthetic_tokens(1, 4, 64)[0], 5)
+        res = eng.run_until_drained()[rid]
+        assert res.ttft is not None and res.ttft > 0
+        assert res.tpot_mean is not None and res.tpot_mean > 0
+        assert res.finish_time > res.first_token_time
+        assert len(res.token_times) == res.emitted == 5
+
+
+class TestServeTelemetry:
+    def test_events_validate_and_metrics_publish(
+        self, lm, lm_params, tmp_path, monkeypatch
+    ):
+        from tpu_dist.observe import events as ev_mod
+        from tpu_dist.observe.registry import REGISTRY
+
+        out = str(tmp_path / "serve_events")
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", out)
+        eng = serve.ServeEngine(
+            lm, lm_params, _cfg(max_batch=2, decode_event_every=1)
+        )
+        prompts = models.synthetic_tokens(3, 5, 64, seed=4)
+        for i in range(3):
+            eng.submit(np.asarray(prompts[i]), 6)
+        eng.run_until_drained()
+        eng.events.close()
+
+        n, errors = ev_mod.validate_dir(out)
+        assert not errors, errors[:5]
+        kinds = {}
+        for rec in ev_mod.read_events(out):
+            kinds.setdefault(rec["event"], []).append(rec)
+        for k in ("request_admit", "prefill", "decode_step",
+                  "request_finish"):
+            assert k in kinds, (k, sorted(kinds))
+        fin = kinds["request_finish"]
+        assert len(fin) == 3
+        assert all(f["emitted"] == 6 for f in fin)
+        assert all(f["finish_reason"] == "length" for f in fin)
+        d = kinds["decode_step"][0]
+        assert set(
+            ("step", "occupancy", "queue_depth", "kv_blocks_used",
+             "kv_block_utilization")
+        ) <= set(d)
+
+        assert REGISTRY.gauge("tpu_dist_serve_kv_blocks_used").value() == 0
+        assert (
+            REGISTRY.histogram("tpu_dist_serve_ttft_seconds").count() >= 3
+        )
+        assert (
+            REGISTRY.histogram("tpu_dist_serve_tpot_seconds").count() > 0
+        )
+
+    def test_tpu_top_renders_serve_line(
+        self, lm, lm_params, tmp_path, monkeypatch
+    ):
+        import os
+        import sys
+
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "tools"),
+        )
+        import tpu_top
+
+        out = str(tmp_path / "serve_top")
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", out)
+        eng = serve.ServeEngine(
+            lm, lm_params, _cfg(decode_event_every=1)
+        )
+        eng.submit(models.synthetic_tokens(1, 4, 64)[0], 4)
+        eng.run_until_drained()
+        eng.events.close()
+        frame = tpu_top.render(tpu_top.collect(out))
+        assert "serve" in frame and "occupancy" in frame
+        assert "queue" in frame and "kv-blocks" in frame
+
+
+class TestLMServer:
+    def test_server_from_artifact_round_trip(self, lm, lm_params, tmp_path):
+        from tpu_dist import export
+
+        path = tmp_path / "weights.npz"
+        export.save_params(lm_params, path)
+        srv = serve.LMServer.from_artifact(lm, path, _cfg())
+        prompt = models.synthetic_tokens(1, 5, 64, seed=1)
+        rid = srv.submit(np.asarray(prompt[0]), 8)
+        res = srv.run_until_drained()
+        dense = np.asarray(lm.generate(lm_params, prompt, 8, cache_len=32))
+        np.testing.assert_array_equal(res[rid].tokens, dense[0])
+        assert srv.result(rid) is res[rid]
+        assert not srv.pending
+
+
+class TestRuntimeSampling:
+    """`serve.sampling`: traced-parameter sampling == the static
+    sampler for equal settings."""
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            dict(temperature=0.0, top_k=None, top_p=None),
+            dict(temperature=0.8, top_k=None, top_p=None),
+            dict(temperature=0.8, top_k=8, top_p=None),
+            dict(temperature=1.0, top_k=None, top_p=0.9),
+            dict(temperature=0.7, top_k=16, top_p=0.8),
+        ],
+    )
+    def test_generate_runtime_matches_static_generate(
+        self, lm, lm_params, kw
+    ):
+        prompt = models.synthetic_tokens(2, 5, 64, seed=3)
+        key = jax.random.key(11)
+        want = np.asarray(lm.generate(lm_params, prompt, 10, key=key, **kw))
+        got = np.asarray(
+            serve.generate_runtime(
+                lm, lm_params, prompt, 10, key=key,
+                temperature=kw["temperature"], top_k=kw["top_k"],
+                top_p=kw["top_p"],
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_one_program_many_configs(self, lm, lm_params):
+        """The whole point: one jitted program serves every sampling
+        config (params are traced, not baked)."""
+        import functools
+
+        prompt = models.synthetic_tokens(1, 4, 64, seed=2)
+        f = jax.jit(
+            functools.partial(serve.generate_runtime, lm, lm_params,
+                              steps=8)
+        )
+        greedy = f(prompt=prompt, key=jax.random.key(0),
+                   temperature=0.0, top_k=0, top_p=1.0)
+        sampled = f(prompt=prompt, key=jax.random.key(0),
+                    temperature=0.9, top_k=8, top_p=0.95)
+        np.testing.assert_array_equal(
+            np.asarray(greedy), np.asarray(lm.generate(lm_params, prompt, 8))
+        )
+        assert not np.array_equal(np.asarray(greedy), np.asarray(sampled))
+
+    def test_sample_slots_greedy_is_argmax(self):
+        logits = jax.random.normal(jax.random.key(0), (4, 16))
+        keys = serve.slot_keys(
+            jnp.arange(4, dtype=jnp.int32), jnp.zeros(4, jnp.int32)
+        )
+        toks = serve.sample_slots(
+            logits, keys, jnp.zeros(4), jnp.zeros(4, jnp.int32),
+            jnp.ones(4),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.asarray(jnp.argmax(logits, -1))
+        )
+
+    def test_cache_overflow_raises(self, lm, lm_params):
+        prompt = models.synthetic_tokens(1, 40, 64, seed=0)
+        with pytest.raises(ValueError, match="exceeds cache length"):
+            serve.generate_runtime(lm, lm_params, prompt, 20)

@@ -88,6 +88,7 @@ def empty_state(dirpath: str) -> dict:
         "notable": [],
         "counts": {},
         "beats": {},
+        "serve": None,     # last decode_step record (serving runs)
     }
 
 
@@ -104,6 +105,8 @@ def update(state: dict, records: list) -> dict:
             state["steps"][rec.get("rank", 0)] = rec
         elif kind == "epoch":
             state["epochs"].append(rec)
+        elif kind == "decode_step":
+            state["serve"] = rec
         if kind in NOTABLE:
             state["notable"].append(rec)
             del state["notable"][:-64]  # bounded; render shows the tail
@@ -159,6 +162,21 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
         )
     if not state["steps"]:
         lines.append("(no step records yet)")
+
+    sv = state.get("serve")
+    if sv:
+        # serving runs (tpu_dist.serve): engine health from the latest
+        # decode_step snapshot — batch occupancy + admission queue depth
+        # + KV block-pool utilization
+        lines.append(
+            f"serve  step {_fmt(sv.get('step'))}"
+            f"  occupancy {_fmt(sv.get('occupancy'))}"
+            f"  queue {_fmt(sv.get('queue_depth'))}"
+            f"  kv-blocks {_fmt(sv.get('kv_blocks_used'))}"
+            f" ({_fmt(sv.get('kv_block_utilization'), '.0%')})"
+            f"  finished {state['counts'].get('request_finish', 0)}"
+            f"  ({_age(sv.get('time'), now)})"
+        )
 
     if state["epochs"]:
         e = state["epochs"][-1]

@@ -43,6 +43,7 @@ from tpu_dist import (  # noqa: E402
     ops,
     parallel,
     resilience,
+    serve,
     train,
     utils,
 )
@@ -59,6 +60,7 @@ __all__ = [
     "ops",
     "parallel",
     "resilience",
+    "serve",
     "train",
     "utils",
 ]

@@ -12,8 +12,13 @@ the model code, the parameter files, or retracing.
   inference forward (``train=False``) over a fixed batch shape.
 - `export_generate(lm, params, prompt_shape, steps, path=, ...)`:
   the KV-cache decode loop (`TransformerLM.generate`) — prefill +
-  scanned sampling compiled into the artifact.
+  scanned sampling compiled into the artifact; sampling config is
+  baked in unless ``runtime_sampling=True`` threads
+  temperature/top_k/top_p through as call-time inputs.
 - `load(path_or_bytes)`: returns a plain callable.
+- `save_params(params, path)` / `load_params(path, like)`: raw-weights
+  artifact for servers that keep sampling a runtime concern
+  (`tpu_dist.serve.LMServer.from_artifact`).
 
 Artifacts are platform-checked at call time by jax.export itself
 (export on CPU runs on CPU; export under a TPU backend for TPU
@@ -71,10 +76,41 @@ def export_generate(
     top_k: int | None = None,
     top_p: float | None = None,
     path: str | Path | None = None,
+    runtime_sampling: bool = False,
 ) -> bytes:
     """Serialize the LM's KV-cache decode: ``(prompt, key) -> tokens``.
     Prompt shape ``(batch, prompt_len)`` and ``steps`` are baked in
-    (static shapes); sampling randomness stays a runtime input."""
+    (static shapes); sampling randomness stays a runtime input.
+
+    By default the SAMPLING CONFIG is baked in too — the artifact
+    freezes ``temperature``/``top_k``/``top_p`` at export time.
+    ``runtime_sampling=True`` threads them through as call-time inputs
+    instead: the artifact's signature becomes ``(prompt, seed,
+    temperature, top_k, top_p)`` (``top_k=0`` / ``top_p=1.0`` disable
+    the truncations, ``temperature=0`` is greedy — the traced
+    stand-ins for ``None``), one artifact serving every sampling
+    configuration; the baked kwargs are then ignored.  Servers that
+    need PER-REQUEST sampling should load raw weights instead
+    (`save_params`/`load_params` + `serve.LMServer`)."""
+
+    if runtime_sampling:
+        from tpu_dist.serve.sampling import generate_runtime
+
+        @jax.jit
+        def gen_rt(prompt, seed, temperature_, top_k_, top_p_):
+            return generate_runtime(
+                lm, params, prompt, steps, key=jax.random.key(seed),
+                temperature=temperature_, top_k=top_k_, top_p=top_p_,
+            )
+
+        spec = (
+            jax.ShapeDtypeStruct(tuple(prompt_shape), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.uint32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+        )
+        return _serialize(gen_rt, spec, path)
 
     @jax.jit
     def gen_seeded(prompt, seed):
@@ -88,6 +124,26 @@ def export_generate(
         jax.ShapeDtypeStruct((), jnp.uint32),
     )
     return _serialize(gen_seeded, spec, path)
+
+
+def save_params(params: Any, path: str | Path) -> None:
+    """Raw-weights artifact (sha256-verified ``.npz`` via
+    `train.checkpoint.save`) — the serving counterpart of the sealed
+    StableHLO artifacts for deployments that keep sampling (and
+    batching) a runtime concern: `serve.LMServer.from_artifact` loads
+    these and decodes with per-request sampling params."""
+    from tpu_dist.train import checkpoint
+
+    checkpoint.save(path, params)
+
+
+def load_params(path: str | Path, like: Any) -> Any:
+    """Load a `save_params` artifact back into the structure of
+    ``like`` (e.g. a freshly-initialized param pytree)."""
+    from tpu_dist.train import checkpoint
+
+    tree, _ = checkpoint.restore(path, like)
+    return tree
 
 
 def load(artifact: str | Path | bytes) -> Callable:

@@ -284,6 +284,7 @@ class TransformerLM(Module):
         top_p: float | None = None,
         cache_len: int | None = None,
         stop_token: int | None = None,
+        sampler=None,
     ):
         """Sample ``steps`` tokens after ``prompt`` ``(b, s_prompt)``.
 
@@ -302,6 +303,12 @@ class TransformerLM(Module):
         emits it keeps emitting it for the remaining steps (frozen), so
         callers can trim on the first occurrence; shapes and compiled
         programs are unchanged.
+
+        ``sampler``: optional ``(logits, key) -> tokens`` override used
+        in place of the static sampling config — the hook through which
+        `serve.sampling.generate_runtime` threads TRACED
+        temperature/top_k/top_p (one compiled program for every
+        sampling configuration); the static kwargs are then ignored.
         """
         from jax import lax
 
@@ -313,7 +320,11 @@ class TransformerLM(Module):
             )
         if key is None:
             key = jax.random.key(0)
-        sample = _make_sampler(temperature, top_k, top_p, prompt.dtype)
+        sample = (
+            sampler
+            if sampler is not None
+            else _make_sampler(temperature, top_k, top_p, prompt.dtype)
+        )
 
         cache = self.init_cache(b, L, dtype=params["embed"]["table"].dtype)
         logits, cache = self.apply_cached(params, prompt, cache, 0)

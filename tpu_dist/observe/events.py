@@ -77,6 +77,20 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "compile_cache": ("outcome",),  # "hit" | "miss" (comm.init cache)
     # compressed gradient sync (comm.compress): per-epoch wire accounting
     "compress": ("wire", "bytes_on_wire", "bytes_saved", "compression_error"),
+    # serving request lifecycle (tpu_dist.serve.ServeEngine):
+    # admission -> chunked prefill -> sampled decode_step (engine-health
+    # snapshot, emitted every decode_event_every steps) -> finish
+    "request_admit": (
+        "request_id", "prompt_tokens", "max_new_tokens", "queue_depth",
+    ),
+    "prefill": ("request_id", "chunk", "tokens", "done"),
+    "decode_step": (
+        "step", "occupancy", "queue_depth", "kv_blocks_used",
+        "kv_block_utilization",
+    ),
+    "request_finish": (
+        "request_id", "emitted", "finish_reason", "ttft", "tpot_mean",
+    ),
 }
 
 

@@ -1,0 +1,48 @@
+"""tpu_dist.serve — the continuous-batching decode server.
+
+The serving half of the north star: a paged/blocked KV cache
+(`paged_kv` — fixed-size blocks in a preallocated pool, per-request
+block tables, bit-compatible with the dense `apply_cached` decode), a
+continuous-batching engine (`engine` — admit/evict at step granularity
+with a chunked prefill/decode split), runtime-parameter sampling
+(`sampling` — per-slot and per-call temperature/top_k/top_p as traced
+values), and a request front-end (`server`).  Benchmarked by
+``make bench-serve`` (Poisson load, continuous vs static batching);
+demoed by ``make serve-demo``.
+"""
+
+from tpu_dist.serve.engine import (
+    Request,
+    RequestResult,
+    SamplingParams,
+    ServeConfig,
+    ServeEngine,
+)
+from tpu_dist.serve.paged_kv import (
+    BlockAllocator,
+    init_paged_cache,
+    paged_apply_cached,
+)
+from tpu_dist.serve.sampling import (
+    generate_runtime,
+    sample_logits,
+    sample_slots,
+    slot_keys,
+)
+from tpu_dist.serve.server import LMServer
+
+__all__ = [
+    "BlockAllocator",
+    "LMServer",
+    "Request",
+    "RequestResult",
+    "SamplingParams",
+    "ServeConfig",
+    "ServeEngine",
+    "generate_runtime",
+    "init_paged_cache",
+    "paged_apply_cached",
+    "sample_logits",
+    "sample_slots",
+    "slot_keys",
+]

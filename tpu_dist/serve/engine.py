@@ -1,0 +1,710 @@
+"""Continuous-batching decode engine — admit/evict at STEP granularity.
+
+`TransformerLM.generate` is static batching: a fixed batch enters
+together, every stream runs the full step count, and a finished stream
+burns its slot until the longest one ends.  Under mixed output lengths
+that is the serving throughput cliff (most of the batch is padding most
+of the time).  This engine is the standard fix:
+
+- a fixed number of DECODE SLOTS (``max_batch``) backed by the paged KV
+  pool (`serve.paged_kv`) — blocks allocated at admission, freed at
+  eviction;
+- a step loop that, EVERY step, evicts finished requests, admits queued
+  ones into the freed slots (FIFO; head-of-line blocks on pool
+  exhaustion, so admission order is deterministic), runs at most one
+  chunked PREFILL (prompt ingestion never stalls in-flight decodes for
+  more than one chunk), then one batched DECODE step over every active
+  slot;
+- per-request sampling params (`sample_slots` — temperature/top_k/top_p
+  are per-slot runtime values, so one compiled step program serves any
+  request mix), per-request PRNG streams keyed by (seed, token index);
+- request-lifecycle telemetry: ``request_admit`` / ``prefill`` /
+  ``decode_step`` / ``request_finish`` events (`observe.events`
+  schema), occupancy / queue-depth / KV-pool gauges and TTFT / TPOT
+  histograms in `observe.registry.REGISTRY`.
+
+Greedy decode through the engine is token-identical to the dense
+`generate` (tested across block sizes) — continuous batching changes
+WHEN a request computes, never WHAT it computes.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.observe import events as ev_mod
+from tpu_dist.observe.registry import REGISTRY
+from tpu_dist.serve.paged_kv import (
+    BlockAllocator,
+    init_paged_cache,
+    paged_apply_cached,
+)
+from tpu_dist.serve.sampling import sample_slots, slot_keys
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling config (the runtime analog of `generate`'s
+    static kwargs).  ``temperature=0`` is greedy; ``seed`` keys the
+    request's private PRNG stream."""
+
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    seed: int = 0
+
+
+@dataclass
+class ServeConfig:
+    """Engine sizing.  ``max_seq`` caps prompt + output per request (it
+    must fit the model's ``max_seq``); the pool holds ``num_blocks``
+    blocks of ``block_size`` positions each, shared by all slots;
+    ``prefill_chunk`` is the prompt-ingestion quantum (one chunk per
+    engine step, interleaved with decode)."""
+
+    max_batch: int = 8
+    block_size: int = 16
+    num_blocks: int = 128
+    max_seq: int = 256
+    prefill_chunk: int = 32
+    prefill_batch: int = 4
+    decode_event_every: int = 8
+    cache_dtype: object = None
+
+
+@dataclass
+class Request:
+    """Internal request record (front-ends construct via
+    `ServeEngine.submit`)."""
+
+    request_id: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    sampling: SamplingParams
+    stop_token: int | None = None
+    # runtime state
+    state: str = "queued"  # queued | prefill | decode | finished
+    slot: int = -1
+    blocks: list = field(default_factory=list)
+    prefill_pos: int = 0
+    tokens: list = field(default_factory=list)
+    arrival_time: float = 0.0
+    first_token_time: float | None = None
+    finish_time: float | None = None
+    token_times: list = field(default_factory=list)
+    finish_reason: str | None = None
+
+
+@dataclass
+class RequestResult:
+    """What the front-end hands back: the emitted tokens plus the
+    latency observables the serving benches report."""
+
+    request_id: int
+    tokens: np.ndarray
+    finish_reason: str
+    prompt_len: int
+    arrival_time: float
+    first_token_time: float | None
+    finish_time: float
+    token_times: list
+
+    @property
+    def emitted(self) -> int:
+        return int(self.tokens.size)
+
+    @property
+    def ttft(self) -> float | None:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    @property
+    def tpot_mean(self) -> float | None:
+        """Mean time per output token after the first (None for
+        single-token or unstarted requests)."""
+        if self.first_token_time is None or self.emitted < 2:
+            return None
+        return (self.finish_time - self.first_token_time) / (self.emitted - 1)
+
+
+class ServeEngine:
+    """The continuous-batching step loop over one model + paged pool.
+
+    ``now``: injectable clock (tests pass a fake for deterministic
+    latency fields; benches pass ``time.perf_counter``).  The engine is
+    single-threaded by design — callers drive `step()` (or
+    `run_until_drained()`); thread-safety belongs to the front-end.
+    """
+
+    def __init__(self, lm, params, config: ServeConfig | None = None, *,
+                 now=time.monotonic, events=None):
+        cfg = config or ServeConfig()
+        if cfg.max_seq > lm.max_seq:
+            raise ValueError(
+                f"config max_seq {cfg.max_seq} exceeds model max_seq "
+                f"{lm.max_seq}"
+            )
+        if cfg.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {cfg.prefill_chunk}"
+            )
+        if cfg.prefill_batch < 1:
+            raise ValueError(
+                f"prefill_batch must be >= 1, got {cfg.prefill_batch}"
+            )
+        self.lm, self.params, self.cfg = lm, params, cfg
+        self._now = now
+        self.events = events if events is not None else ev_mod.from_env()
+        self.blocks_per_seq = math.ceil(cfg.max_seq / cfg.block_size)
+        self.context_len = self.blocks_per_seq * cfg.block_size
+        self.allocator = BlockAllocator(cfg.num_blocks)
+        dtype = cfg.cache_dtype or params["embed"]["table"].dtype
+        self.cache = init_paged_cache(
+            lm, cfg.num_blocks, cfg.block_size, dtype
+        )
+        self.scratch = cfg.num_blocks
+
+        S, MB = cfg.max_batch, self.blocks_per_seq
+        self.block_tables = np.full((S, MB), self.scratch, np.int32)
+        self.index = np.zeros((S,), np.int32)
+        self.active = np.zeros((S,), bool)
+        self.last_tok = np.zeros((S,), np.int32)
+        self.temperature = np.zeros((S,), np.float32)
+        self.top_k = np.zeros((S,), np.int32)
+        self.top_p = np.ones((S,), np.float32)
+        self.seeds = np.zeros((S,), np.int32)
+        self.counters = np.zeros((S,), np.int32)
+
+        self.slots: list[Request | None] = [None] * S
+        self.queue: deque[Request] = deque()
+        self._prefillq: deque[int] = deque()
+        self._cancelled: set[int] = set()
+        self.results: dict[int, RequestResult] = {}
+        self.step_count = 0
+        self.steps_with_decode = 0
+        self.steps_with_prefill = 0
+        self._next_id = 0
+        # (kind, ...) tuples, appended in processing order — the
+        # determinism tests' observable
+        self.audit: list[tuple] = []
+
+        self._decode_fn = self._build_decode_fn(greedy=False)
+        self._decode_fn_greedy = self._build_decode_fn(greedy=True)
+        self._prefill_fn = self._build_prefill_fn()
+        # device-resident decode state: the per-slot scheduling arrays
+        # ride the jitted step's output back into the next step's input
+        # as ONE packed int32 array (block tables, active mask, sampling
+        # ints, last token, position, token counter) plus one small f32
+        # array (temperature, top_p) — a steady-state decode step
+        # transfers nothing host->device, and a slot-map change (admit /
+        # activate / evict) rebuilds both with two device_puts
+        self._dint = None
+        self._dflt = None
+        self._dirty = True
+        self._warming = False
+        self._g_occ = REGISTRY.gauge(
+            "tpu_dist_serve_batch_occupancy",
+            "active decode slots in the serving batch",
+        )
+        self._g_queue = REGISTRY.gauge(
+            "tpu_dist_serve_queue_depth", "requests waiting for admission"
+        )
+        self._g_blocks = REGISTRY.gauge(
+            "tpu_dist_serve_kv_blocks_used", "allocated KV pool blocks"
+        )
+        self._g_util = REGISTRY.gauge(
+            "tpu_dist_serve_kv_block_utilization",
+            "allocated fraction of the KV block pool",
+        )
+        self._h_ttft = REGISTRY.histogram(
+            "tpu_dist_serve_ttft_seconds", "time to first token"
+        )
+        self._h_tpot = REGISTRY.histogram(
+            "tpu_dist_serve_tpot_seconds", "per-token decode latency"
+        )
+
+    # ------------------------------------------------------------- jit fns
+
+    # packed int-state column layout (after the MB block-table columns)
+    _ACTIVE, _TOPK, _SEED, _LASTTOK, _INDEX, _COUNTER = range(6)
+
+    def _pack_state(self):
+        MB = self.blocks_per_seq
+        ints = np.empty((self.cfg.max_batch, MB + 6), np.int32)
+        ints[:, :MB] = self.block_tables
+        ints[:, MB + self._ACTIVE] = self.active
+        ints[:, MB + self._TOPK] = self.top_k
+        ints[:, MB + self._SEED] = self.seeds
+        ints[:, MB + self._LASTTOK] = self.last_tok
+        ints[:, MB + self._INDEX] = self.index
+        ints[:, MB + self._COUNTER] = self.counters
+        flt = np.stack([self.temperature, self.top_p], axis=1)
+        return ints, flt.astype(np.float32)
+
+    def _build_decode_fn(self, *, greedy: bool):
+        """One batched decode step over the packed state.
+        ``greedy=True`` is the fast path taken when every active slot
+        has temperature 0 — no sorts, no key derivation, plain argmax
+        (exactly `generate`'s greedy op)."""
+        lm, bs, MB = self.lm, self.cfg.block_size, self.blocks_per_seq
+
+        def fn(params, cache, ints, flt):
+            block_tables = ints[:, :MB]
+            active = ints[:, MB + self._ACTIVE].astype(bool)
+            last_tok = ints[:, MB + self._LASTTOK]
+            index = ints[:, MB + self._INDEX]
+            logits, cache = paged_apply_cached(
+                lm, params, last_tok[:, None], cache, block_tables,
+                index[:, None], active[:, None], bs,
+            )
+            if greedy:
+                toks = jnp.argmax(logits[:, 0], axis=-1).astype(
+                    last_tok.dtype
+                )
+            else:
+                keys = slot_keys(
+                    ints[:, MB + self._SEED], ints[:, MB + self._COUNTER]
+                )
+                toks = sample_slots(
+                    logits[:, 0], keys, flt[:, 0],
+                    ints[:, MB + self._TOPK], flt[:, 1], last_tok.dtype,
+                )
+            inc = active.astype(jnp.int32)
+            ints = ints.at[:, MB + self._LASTTOK].set(
+                jnp.where(active, toks, last_tok)
+            )
+            ints = ints.at[:, MB + self._INDEX].add(inc)
+            ints = ints.at[:, MB + self._COUNTER].add(inc)
+            return toks, ints, cache
+
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _build_prefill_fn(self):
+        """One prompt chunk for EACH of P pending requests (P = however
+        many rows the host passes, retraced per distinct P up to
+        ``prefill_batch``) — distinct requests only, since a request's
+        later chunks attend its earlier ones.  Also samples each row's
+        would-be first output token from its last real position (the
+        host uses it only for rows whose prompt just completed)."""
+        lm, bs, C = self.lm, self.cfg.block_size, self.cfg.prefill_chunk
+        MB = self.blocks_per_seq
+
+        def fn(params, cache, ints, flt):
+            # ints columns: [tokens(C) | block_table(MB) | start |
+            #                real_len | top_k | seed]
+            tokens = ints[:, :C]
+            block_tables = ints[:, C : C + MB]
+            start = ints[:, C + MB]
+            real_len = ints[:, C + MB + 1]
+            positions = start[:, None] + jnp.arange(C, dtype=jnp.int32)
+            write_mask = jnp.arange(C)[None, :] < real_len[:, None]
+            logits, cache = paged_apply_cached(
+                lm, params, tokens, cache, block_tables, positions,
+                write_mask, bs,
+            )
+            last = jnp.take_along_axis(
+                logits,
+                jnp.maximum(real_len, 1)[:, None, None] - 1,
+                axis=1,
+            )[:, 0]
+            keys = slot_keys(
+                ints[:, C + MB + 3], jnp.zeros_like(real_len)
+            )
+            toks = sample_slots(
+                last, keys, flt[:, 0], ints[:, C + MB + 2], flt[:, 1],
+                tokens.dtype,
+            )
+            return toks, cache
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    # ---------------------------------------------------------- front door
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               sampling: SamplingParams | None = None,
+               stop_token: int | None = None) -> int:
+        """Queue one request; returns its id.  Admission happens inside
+        `step()` (a submit never blocks on pool space)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt {prompt.size} + max_new_tokens {max_new_tokens} "
+                f"exceeds serve max_seq {self.cfg.max_seq}"
+            )
+        need = math.ceil(
+            (prompt.size + max_new_tokens) / self.cfg.block_size
+        )
+        if need > self.cfg.num_blocks:
+            # admitting is impossible even with an empty pool; queueing
+            # it would livelock the FIFO head forever
+            raise ValueError(
+                f"request needs {need} KV blocks but the pool holds "
+                f"only {self.cfg.num_blocks}"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        req = Request(
+            request_id=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+            sampling=sampling or SamplingParams(), stop_token=stop_token,
+            arrival_time=self._now(),
+        )
+        self.queue.append(req)
+        return rid
+
+    def cancel(self, request_id: int) -> bool:
+        """Cancel a queued or in-flight request.  Queued: removed
+        immediately.  Running: evicted at the start of the next step
+        (its partial tokens are returned with ``finish_reason
+        'cancelled'``).  Returns False for unknown/finished ids."""
+        for i, req in enumerate(self.queue):
+            if req.request_id == request_id:
+                del self.queue[i]
+                self._finalize(req, "cancelled", self._now())
+                return True
+        for req in self.slots:
+            if req is not None and req.request_id == request_id:
+                self._cancelled.add(request_id)
+                return True
+        return False
+
+    @property
+    def pending(self) -> bool:
+        return bool(self.queue) or any(r is not None for r in self.slots)
+
+    def run_until_drained(self, max_steps: int = 100_000):
+        """Drive `step()` until queue and slots are empty; returns the
+        results dict (id -> `RequestResult`)."""
+        steps = 0
+        while self.pending:
+            self.step()
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(
+                    f"engine not drained after {max_steps} steps "
+                    f"(queue={len(self.queue)}, "
+                    f"occupied={sum(r is not None for r in self.slots)})"
+                )
+        return self.results
+
+    # ------------------------------------------------------------ the step
+
+    def step(self) -> None:
+        """One engine step: evict cancels, admit, one decode step plus
+        one batched prefill round — DISPATCHED back-to-back before
+        either is read back, so the host's bookkeeping for one overlaps
+        the device's compute for the other — then publish telemetry.
+
+        Prefill-priority at low occupancy: while more prefills would
+        remain after this round and no more than half the decode slots
+        are active, the decode step is skipped for this engine step —
+        filling slots fast raises the occupancy every later decode step
+        amortizes over, at the bounded cost of delaying at most half a
+        batch by one prefill round."""
+        self._process_cancels()
+        self._admit()
+        prefer_prefill = (
+            len(self._prefillq) > self.cfg.prefill_batch
+            and self.occupancy() <= self.cfg.max_batch // 2
+        )
+        decode_toks = None if prefer_prefill else self._decode_dispatch()
+        prefill_ctx = self._prefill_dispatch()
+        did_decode = self._decode_complete(decode_toks)
+        did_prefill = self._prefill_complete(prefill_ctx)
+        self.steps_with_prefill += bool(did_prefill)
+        self.steps_with_decode += bool(did_decode)
+        self._publish(did_prefill or did_decode)
+        self.step_count += 1
+
+    def _process_cancels(self) -> None:
+        if not self._cancelled:
+            return
+        tnow = self._now()
+        for s, req in enumerate(self.slots):
+            if req is not None and req.request_id in self._cancelled:
+                self._cancelled.discard(req.request_id)
+                if s in self._prefillq:
+                    self._prefillq.remove(s)
+                self._evict(s, "cancelled", tnow)
+        self._cancelled.clear()  # ids that were already finished
+
+    def _admit(self) -> None:
+        while self.queue:
+            free = [s for s, r in enumerate(self.slots) if r is None]
+            if not free:
+                break
+            req = self.queue[0]
+            need = math.ceil(
+                (req.prompt.size + req.max_new_tokens) / self.cfg.block_size
+            )
+            blocks = self.allocator.alloc(need)
+            if blocks is None:
+                break  # head-of-line blocks; FIFO stays deterministic
+            self.queue.popleft()
+            s = free[0]
+            req.slot, req.blocks, req.state = s, blocks, "prefill"
+            self.slots[s] = req
+            self.block_tables[s, :] = self.scratch
+            self.block_tables[s, : len(blocks)] = blocks
+            self.index[s] = 0
+            self.active[s] = False
+            sp = req.sampling
+            self.temperature[s] = sp.temperature
+            self.top_k[s] = 0 if sp.top_k is None else sp.top_k
+            self.top_p[s] = 1.0 if sp.top_p is None else sp.top_p
+            # seed rides the packed int32 state: keep the low 32 bits
+            # (two's complement) so any Python int is a valid seed
+            s32 = sp.seed & 0xFFFFFFFF
+            self.seeds[s] = s32 - (1 << 32) if s32 >= 1 << 31 else s32
+            self.counters[s] = 0
+            self._dirty = True
+            self._prefillq.append(s)
+            self.audit.append(
+                ("admit", req.request_id, s, tuple(blocks), self.step_count)
+            )
+            self.events.emit(
+                "request_admit",
+                request_id=req.request_id,
+                prompt_tokens=int(req.prompt.size),
+                max_new_tokens=int(req.max_new_tokens),
+                queue_depth=len(self.queue),
+            )
+
+    def _prefill_dispatch(self):
+        """Assemble + dispatch one chunk for each of (up to
+        ``prefill_batch``) oldest prefilling requests in ONE batched
+        call — distinct requests only, since a request's later chunks
+        attend its earlier ones.  Returns the (chunks, first-token
+        device handle) context for `_prefill_complete`, or None."""
+        if not self._prefillq:
+            return None
+        C, MB = self.cfg.prefill_chunk, self.blocks_per_seq
+        take = list(self._prefillq)[: self.cfg.prefill_batch]
+        P = len(take)
+        ints = np.zeros((P, C + MB + 4), np.int32)
+        flt = np.zeros((P, 2), np.float32)
+        chunks = []
+        for r, s in enumerate(take):
+            req = self.slots[s]
+            start = req.prefill_pos
+            chunk = req.prompt[start : start + C]
+            chunks.append((s, req, start, chunk.size))
+            ints[r, : chunk.size] = chunk
+            ints[r, C : C + MB] = self.block_tables[s]
+            ints[r, C + MB] = start
+            ints[r, C + MB + 1] = chunk.size
+            ints[r, C + MB + 2] = self.top_k[s]
+            ints[r, C + MB + 3] = self.seeds[s]
+            flt[r, 0] = self.temperature[s]
+            flt[r, 1] = self.top_p[s]
+        first_toks, self.cache = self._prefill_fn(
+            self.params, self.cache, ints, flt
+        )
+        return chunks, first_toks
+
+    def _prefill_complete(self, ctx) -> bool:
+        """Apply a dispatched prefill round: advance positions; rows
+        whose prompt completed get their first output token (sampled
+        from the chunk's last logits exactly as `generate` samples from
+        its prefill logits — this is the TTFT moment) and join the
+        decode batch."""
+        if ctx is None:
+            return False
+        chunks, first_toks = ctx
+        finishing = [
+            r for r, (s, req, start, size) in enumerate(chunks)
+            if start + size >= req.prompt.size
+        ]
+        toks_np = np.asarray(first_toks) if finishing else None
+        tnow = self._now()
+        for r, (s, req, start, size) in enumerate(chunks):
+            req.prefill_pos += size
+            self.events.emit(
+                "prefill",
+                request_id=req.request_id,
+                chunk=start // self.cfg.prefill_chunk,
+                tokens=size,
+                done=req.prefill_pos >= req.prompt.size,
+            )
+            if req.prefill_pos < req.prompt.size:
+                continue
+            self._prefillq.remove(s)
+            tok = int(toks_np[r])
+            req.tokens.append(tok)
+            req.token_times.append(tnow)
+            req.first_token_time = tnow
+            if not self._warming:
+                self._h_ttft.observe(tnow - req.arrival_time)
+            self.counters[s] += 1
+            self.last_tok[s] = tok
+            self.index[s] = req.prompt.size
+            req.state = "decode"
+            self.active[s] = True
+            self._dirty = True
+            if self._finished_by(req, tok):
+                self._evict(s, self._finish_reason(req, tok), tnow)
+        return True
+
+    def _decode_dispatch(self):
+        """Dispatch one batched token for every active slot (no
+        readback yet).  Returns the tokens' device handle, or None."""
+        if not self.active.any():
+            return None
+        if self._dirty:
+            self._dint, self._dflt = self._pack_state()
+            self._dirty = False
+        fn = (
+            self._decode_fn_greedy
+            if not self.temperature[self.active].any()
+            else self._decode_fn
+        )
+        toks, self._dint, self.cache = fn(
+            self.params, self.cache, self._dint, self._dflt
+        )
+        return toks
+
+    def _decode_complete(self, toks) -> bool:
+        """Read back a dispatched decode step, then finish/evict the
+        streams that completed — THE every-step admit/evict cycle's
+        compute half."""
+        if toks is None:
+            return False
+        toks_np = np.asarray(toks)  # host sync: the step boundary
+        tnow = self._now()
+        active = np.nonzero(self.active)[0]
+        self.last_tok[active] = toks_np[active]
+        self.index[active] += 1
+        self.counters[active] += 1
+        for s in active:
+            req = self.slots[s]
+            tok = int(toks_np[s])
+            if req.token_times and not self._warming:
+                self._h_tpot.observe(tnow - req.token_times[-1])
+            req.tokens.append(tok)
+            req.token_times.append(tnow)
+            if self._finished_by(req, tok):
+                self._evict(s, self._finish_reason(req, tok), tnow)
+        return True
+
+    @staticmethod
+    def _finished_by(req: Request, tok: int) -> bool:
+        return (
+            len(req.tokens) >= req.max_new_tokens
+            or (req.stop_token is not None and tok == req.stop_token)
+        )
+
+    @staticmethod
+    def _finish_reason(req: Request, tok: int) -> str:
+        if req.stop_token is not None and tok == req.stop_token:
+            return "stop"
+        return "length"
+
+    def _evict(self, s: int, reason: str, tnow: float) -> None:
+        req = self.slots[s]
+        self.allocator.free(req.blocks)
+        req.blocks = []
+        self.slots[s] = None
+        self.block_tables[s, :] = self.scratch
+        self.active[s] = False
+        self._dirty = True
+        self._finalize(req, reason, tnow)
+
+    def _finalize(self, req: Request, reason: str, tnow: float) -> None:
+        req.state, req.finish_reason, req.finish_time = (
+            "finished", reason, tnow,
+        )
+        result = RequestResult(
+            request_id=req.request_id,
+            tokens=np.asarray(req.tokens, np.int32),
+            finish_reason=reason,
+            prompt_len=int(req.prompt.size),
+            arrival_time=req.arrival_time,
+            first_token_time=req.first_token_time,
+            finish_time=tnow,
+            token_times=list(req.token_times),
+        )
+        self.results[req.request_id] = result
+        self.audit.append(
+            ("finish", req.request_id, reason, len(req.tokens),
+             self.step_count)
+        )
+        self.events.emit(
+            "request_finish",
+            request_id=req.request_id,
+            emitted=len(req.tokens),
+            finish_reason=reason,
+            ttft=result.ttft,
+            tpot_mean=result.tpot_mean,
+        )
+
+    def _publish(self, worked: bool) -> None:
+        occ = int(self.active.sum())
+        self._g_occ.set(occ)
+        self._g_queue.set(len(self.queue))
+        self._g_blocks.set(self.allocator.used)
+        self._g_util.set(self.allocator.utilization())
+        if worked and self.step_count % self.cfg.decode_event_every == 0:
+            self.events.emit(
+                "decode_step",
+                step=self.step_count,
+                occupancy=occ,
+                queue_depth=len(self.queue),
+                kv_blocks_used=self.allocator.used,
+                kv_block_utilization=self.allocator.utilization(),
+            )
+
+    # ----------------------------------------------------------- accessors
+
+    def occupancy(self) -> int:
+        return int(self.active.sum())
+
+    def warmup(self) -> None:
+        """Compile the serving programs with throwaway requests so the
+        first real request does not pay compile time (benches call this
+        before starting their clocks): each prefill row count P in
+        1..prefill_batch (retraced per P), the greedy decode fast path,
+        AND the sampled decode path (one tempered request).  Telemetry
+        is suppressed for the duration — no lifecycle events, no
+        TTFT/TPOT observations — so dashboards never see the throwaway
+        requests or their compile-dominated latencies."""
+        events, self.events = self.events, ev_mod.NULL
+        self._warming = True
+        try:
+            for p in range(1, min(self.cfg.prefill_batch,
+                                  self.cfg.max_batch) + 1):
+                rids = [
+                    self.submit(np.zeros((1,), np.int32), 2)
+                    for _ in range(p)
+                ]
+                self.run_until_drained()
+                for rid in rids:
+                    del self.results[rid]
+            rid = self.submit(
+                np.zeros((1,), np.int32), 2,
+                sampling=SamplingParams(
+                    temperature=0.5, top_k=2, top_p=0.9
+                ),
+            )
+            self.run_until_drained()
+            del self.results[rid]
+        finally:
+            self.events = events
+            self._warming = False
+        self.audit.clear()
+        self.step_count = 0
+        self.steps_with_decode = 0
+        self.steps_with_prefill = 0

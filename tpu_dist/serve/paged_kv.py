@@ -1,0 +1,213 @@
+"""Paged KV cache — fixed-size blocks in a preallocated pool.
+
+The dense decode path (`TransformerLM.init_cache` / `apply_cached`)
+allocates one contiguous ``(batch, kv_heads, cache_len, head_dim)``
+cache per request slot, so a slot's HBM is pinned for the slot's
+longest possible sequence whether or not it is used.  Serving under
+continuous batching wants the opposite: KV memory is a POOL of
+fixed-size blocks (``block_size`` token positions each), and every
+request owns just the blocks its tokens actually fill, mapped through a
+per-request **block table** (logical block index -> physical block id)
+— the vLLM/PagedAttention layout.  Blocks are handed out by the
+host-side `BlockAllocator` at admission and returned at eviction; the
+device never sees the free list, only the tables.
+
+The device side is `paged_apply_cached`: the SAME math as
+`TransformerLM.apply_cached` (tests assert greedy decode through it is
+token-identical to the dense `generate`) with two differences:
+
+- **write**: a token's k/v rows scatter into
+  ``pool[table[pos // block_size], :, pos % block_size]`` instead of a
+  ``dynamic_update_slice`` into a contiguous cache (masked-off tokens —
+  pads, inactive slots — write to a reserved scratch block);
+- **read**: the per-slot tables gather the pool back into a contiguous
+  ``(slots, kv_heads, L, head_dim)`` view, after which the attention
+  (scale, position mask, -1e30 fill, softmax) is exactly the dense
+  incremental attention, per-slot positions included.
+
+Everything is static-shape: one compiled program serves every decode
+step and every prefill chunk regardless of which requests occupy which
+slots.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class BlockAllocator:
+    """Host-side free-list over the physical KV blocks.
+
+    Deterministic (LIFO free list, ids handed out in ascending order
+    from a fresh pool) so a seeded arrival trace produces an identical
+    block-table history run to run — the engine's determinism tests
+    rely on it.  Double-free and foreign ids raise instead of silently
+    corrupting the pool."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = num_blocks
+        # pop() yields 0, 1, 2, ... for a fresh pool
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._allocated: set[int] = set()
+        self.high_water = 0
+
+    @property
+    def used(self) -> int:
+        return len(self._allocated)
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    def utilization(self) -> float:
+        return self.used / self.num_blocks
+
+    def alloc(self, n: int) -> list[int] | None:
+        """``n`` block ids, or None if the pool cannot satisfy the
+        request (caller keeps the request queued — no partial grants)."""
+        if n < 1:
+            raise ValueError(f"alloc needs n >= 1, got {n}")
+        if n > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n)]
+        self._allocated.update(blocks)
+        self.high_water = max(self.high_water, self.used)
+        return blocks
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b not in self._allocated:
+                raise ValueError(f"freeing unallocated block {b}")
+            self._allocated.remove(b)
+            self._free.append(b)
+
+
+def init_paged_cache(lm, num_blocks: int, block_size: int, dtype=None):
+    """The device pool: per transformer block one ``{"k", "v"}`` pair of
+    ``(num_blocks + 1, kv_heads, block_size, head_dim)`` arrays.  Index
+    ``num_blocks`` is the SCRATCH block — masked writes (pad tokens,
+    inactive slots) land there and nothing ever reads it through a real
+    block table."""
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    hd = lm.dim // lm.heads
+    dt = dtype or jnp.float32
+    shape = (num_blocks + 1, lm.kv_heads, block_size, hd)
+    # distinct buffers per block/side: the engine donates the whole
+    # cache pytree into its jitted steps, and donation rejects aliased
+    # buffers
+    return [
+        {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        for _ in lm.blocks
+    ]
+
+
+def _rope_slots(x, positions, *, base: float = 10000.0):
+    """`nn.attention.rope` with PER-SLOT positions: ``x`` is
+    ``(slots, heads, s, head_dim)`` and ``positions`` is ``(slots, s)``
+    — each decode slot sits at its own global position.  Elementwise
+    identical to the shared-positions rope for equal position values."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions[:, None, :, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _paged_attention(attn, params, x, k_pool, v_pool, block_tables,
+                     positions, write_mask, block_size: int):
+    """One block's incremental attention against the paged pool.
+
+    ``x``: ``(S, s, dim)`` new-token activations for S slots;
+    ``positions``: ``(S, s)`` global positions; ``write_mask``:
+    ``(S, s)`` — True rows write their k/v into the pool, False rows
+    (pads / inactive slots) write to the scratch block.  Returns
+    ``(y, k_pool, v_pool)`` — same contract as
+    `MultiHeadAttention.apply_cached`, with the contiguous cache
+    replaced by the (scatter, gather) pair."""
+    S, s, _ = x.shape
+    q, k, v = attn._project(params, x)
+    if attn.use_rope:
+        q, k = _rope_slots(q, positions), _rope_slots(k, positions)
+
+    scratch = k_pool.shape[0] - 1
+    blk = jnp.take_along_axis(block_tables, positions // block_size, axis=1)
+    blk = jnp.where(write_mask, blk, scratch).reshape(-1)
+    off = (positions % block_size).reshape(-1)
+    k_w = jnp.moveaxis(k.astype(k_pool.dtype), 1, 2).reshape(
+        S * s, attn.kv_heads, attn.head_dim
+    )
+    v_w = jnp.moveaxis(v.astype(v_pool.dtype), 1, 2).reshape(
+        S * s, attn.kv_heads, attn.head_dim
+    )
+    k_pool = k_pool.at[blk, :, off].set(k_w)
+    v_pool = v_pool.at[blk, :, off].set(v_w)
+
+    # gather the per-slot tables back into the contiguous dense-cache
+    # layout; from here on the math is exactly apply_cached's
+    L = block_tables.shape[1] * block_size
+    k_full = jnp.moveaxis(k_pool[block_tables], 2, 1).reshape(
+        S, attn.kv_heads, L, attn.head_dim
+    )
+    v_full = jnp.moveaxis(v_pool[block_tables], 2, 1).reshape(
+        S, attn.kv_heads, L, attn.head_dim
+    )
+    scale = attn.head_dim**-0.5
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q * scale, attn._expand_kv(k_full).astype(q.dtype)
+    )
+    pos_k = jnp.arange(L)[None, None, :]
+    qpos = positions[:, :, None]
+    visible = pos_k <= qpos  # (S, s, L), per-slot positions
+    if attn.sliding_window is not None:
+        visible = visible & (pos_k > qpos - attn.sliding_window)
+    logits = jnp.where(visible[:, None], logits, -1e30)
+    weights = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", weights, attn._expand_kv(v_full).astype(q.dtype)
+    )
+    o = jnp.moveaxis(o, 1, 2).reshape(S, s, attn.dim)
+    y, _ = attn._out.apply(params["out"], {}, o)
+    return y, k_pool, v_pool
+
+
+def paged_apply_cached(lm, params, tokens, cache, block_tables, positions,
+                       write_mask, block_size: int):
+    """`TransformerLM.apply_cached` against the paged pool.
+
+    ``tokens``: ``(S, s)`` new tokens for S slots (s = 1 for decode
+    steps, s = chunk for prefill); ``positions``: ``(S, s)`` each
+    token's global position; ``block_tables``: ``(S, max_blocks)``
+    physical block ids per slot; ``write_mask``: ``(S, s)`` True where
+    the token is real (False rows read/write scratch and their logits
+    are garbage the caller ignores).  Returns
+    ``(logits (S, s, vocab), new_cache)``.
+
+    Token-identical to the dense path by construction: the gathered
+    pool view equals the dense contiguous cache for every visible
+    position, and every op after the gather is the dense op."""
+    L = block_tables.shape[1] * block_size
+    positions = jnp.clip(positions, 0, min(lm.max_seq, L) - 1)
+    h = params["embed"]["table"][tokens]
+    if lm.pos_embedding == "learned":
+        h = h + params["pos"][0][positions]
+    new_cache = []
+    for blk, pb, c in zip(lm.blocks, params["blocks"], cache):
+        x1, _ = blk.ln1.apply(pb["ln1"], {}, h)
+        o, ck, cv = _paged_attention(
+            blk.attn, pb["attn"], x1, c["k"], c["v"], block_tables,
+            positions, write_mask, block_size,
+        )
+        h = h + o
+        x2, _ = blk.ln2.apply(pb["ln2"], {}, h)
+        h = h + lm._mlp_or_moe(blk, pb, x2)
+        new_cache.append({"k": ck, "v": cv})
+    h, _ = lm.ln.apply(params["ln"], {}, h)
+    logits = h @ params["embed"]["table"].T
+    return logits, new_cache

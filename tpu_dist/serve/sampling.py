@@ -1,0 +1,132 @@
+"""Sampling with RUNTIME parameters — per-slot and per-call.
+
+`models._make_sampler` specializes the compiled program on its sampling
+config (temperature/top_k/top_p are Python statics), which is right for
+a single stream but wrong for a serving batch: every decode slot holds
+a different request with different sampling params, and recompiling per
+combination is out of the question.  These samplers take the params as
+TRACED values instead — one compiled program covers every request mix:
+
+- `sample_slots`: per-slot arrays ``(S,)`` of temperature/top_k/top_p
+  plus per-slot PRNG keys — the continuous-batching engine's sampler.
+- `sample_logits`: scalar traced params, one key for the whole batch —
+  the per-call analog of `_make_sampler` used by
+  `export.export_generate(runtime_sampling=True)`; for equal settings
+  it reproduces the static sampler exactly (tested).
+- `generate_runtime`: `TransformerLM.generate` with the sampling
+  params threaded through as runtime inputs.
+
+Disabled encodings (the traced stand-ins for ``None``): ``top_k <= 0``
+and ``top_p >= 1.0`` are no-ops; ``temperature == 0`` selects greedy
+argmax exactly like the static sampler.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG = -1e30
+
+
+def _mask_top_k(scaled, top_k):
+    """Keep each row's ``top_k`` highest logits (``top_k <= 0`` = off).
+    Same kth-value rule as the static sampler: ties at the threshold
+    survive (``< kth`` is masked, ``== kth`` is not)."""
+    V = scaled.shape[-1]
+    top_k = jnp.broadcast_to(jnp.asarray(top_k), scaled.shape[:-1])
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    k_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[..., None], axis=-1)
+    enabled = (top_k > 0)[..., None]
+    return jnp.where(enabled & (scaled < kth), _NEG, scaled)
+
+
+def _mask_top_p(scaled, top_p):
+    """Nucleus truncation at runtime ``top_p`` (``>= 1.0`` = off): drop
+    tokens in the tail beyond cumulative probability ``top_p``; the
+    highest-probability token always survives (its exclusive cumsum is
+    0).  Applied AFTER top-k, matching the static sampler's order."""
+    top_p = jnp.broadcast_to(
+        jnp.asarray(top_p, scaled.dtype), scaled.shape[:-1]
+    )
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+    cutoff_idx = jnp.sum(cum < top_p[..., None], axis=-1, keepdims=True) - 1
+    cutoff_idx = jnp.clip(cutoff_idx, 0, scaled.shape[-1] - 1)
+    cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
+    enabled = (top_p < 1.0)[..., None]
+    return jnp.where(enabled & (scaled < cutoff), _NEG, scaled)
+
+
+def _masked(logits, temperature, top_k, top_p):
+    t = jnp.asarray(temperature, logits.dtype)
+    safe_t = jnp.where(t == 0, jnp.ones_like(t), t)
+    if safe_t.ndim:  # per-slot (S,) against (S, V) logits
+        safe_t = safe_t[..., None]
+    scaled = logits / safe_t
+    return _mask_top_p(_mask_top_k(scaled, top_k), top_p)
+
+
+def sample_logits(logits, key, temperature, top_k, top_p,
+                  dtype=jnp.int32):
+    """One batch draw from ``(b, vocab)`` logits, scalar traced params,
+    single key (the whole batch shares the categorical draw, exactly
+    like `_make_sampler`).  ``temperature == 0`` is greedy argmax."""
+    greedy = jnp.argmax(logits, axis=-1).astype(dtype)
+    sampled = jax.random.categorical(
+        key, _masked(logits, temperature, top_k, top_p)
+    ).astype(dtype)
+    return jnp.where(jnp.asarray(temperature) == 0, greedy, sampled)
+
+
+def sample_slots(logits, keys, temperature, top_k, top_p,
+                 dtype=jnp.int32):
+    """Per-slot sampling for the serving batch: ``logits (S, vocab)``,
+    per-slot ``keys (S,)`` typed PRNG keys and ``(S,)`` params.  Each
+    slot draws independently with its own key, so a request's token
+    stream depends only on its own (seed, token index) — deterministic
+    regardless of which slot it lands in or who shares the batch."""
+    greedy = jnp.argmax(logits, axis=-1).astype(dtype)
+    sampled = jax.vmap(jax.random.categorical)(
+        keys, _masked(logits, temperature, top_k, top_p)
+    ).astype(dtype)
+    return jnp.where(jnp.asarray(temperature) == 0, greedy, sampled)
+
+
+def slot_keys(seeds, counters):
+    """Per-slot PRNG keys: ``fold_in(key(seed), counter)`` — seed is
+    the request's, counter is its token index, so the stream is a pure
+    function of the request, not of scheduling."""
+    def one(seed, counter):
+        return jax.random.fold_in(jax.random.key(seed), counter)
+
+    return jax.vmap(one)(seeds, counters)
+
+
+def generate_runtime(lm, params, prompt, steps: int, *, key=None,
+                     temperature=0.0, top_k=0, top_p=1.0,
+                     cache_len: int | None = None,
+                     stop_token: int | None = None):
+    """`TransformerLM.generate` with RUNTIME sampling params:
+    ``temperature``/``top_k``/``top_p`` are traced scalars — one
+    compiled program (or one exported artifact) serves every sampling
+    configuration.  ``top_k=0`` / ``top_p=1.0`` disable the
+    truncations (the traced stand-ins for ``None``);
+    ``temperature=0`` is greedy.  For equal settings the tokens match
+    `generate` exactly (tested) — this IS `generate`'s decode loop,
+    entered through its ``sampler`` hook, so `stop_token` freeze
+    semantics carry over unchanged."""
+    top_k = 0 if top_k is None else top_k
+    top_p = 1.0 if top_p is None else top_p
+
+    def sampler(logits, k):
+        return sample_logits(
+            logits, k, temperature, top_k, top_p, prompt.dtype
+        )
+
+    return lm.generate(
+        params, prompt, steps, key=key, cache_len=cache_len,
+        stop_token=stop_token, sampler=sampler,
+    )
