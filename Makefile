@@ -10,7 +10,7 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
         chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
-        bench-pipeline bench-decode bench-serve serve-demo
+        bench-pipeline bench-decode bench-serve serve-demo bench-mesh
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -60,6 +60,9 @@ bench-compress:  # gradient-sync backends + bucket-size sweep (bytes-on-wire, GB
 bench-pipeline:  # 1F1B vs GPipe vs pure dp goodput at equal chips (matched depth)
 	$(PY) benchmarks/lm_train.py --platform $(PLATFORM) --pipeline 1f1b
 	$(PY) benchmarks/lm_train.py --platform $(PLATFORM) --pipeline gpipe --pipe-blocks 2
+
+bench-mesh:  # partition rule sets (dp/zero1/fsdp/dp×fsdp/dp×tp) at equal chips
+	$(PY) benchmarks/mesh.py --platform $(PLATFORM) --world $(WORLD)
 
 runtime:
 	$(MAKE) -C tpu_dist/runtime

@@ -126,12 +126,19 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
     man = state["manifest"]
     if man:
         plat = man.get("platform") or {}
+        # mesh column: axis names/sizes + the active partition rule set
+        # (partition-engine runs; legacy strategy runs show axes only)
+        part = man.get("partition") or {}
+        axes = part.get("axes") or (man.get("mesh") or {}).get("shape") or {}
+        mesh_s = ",".join(f"{k}={v}" for k, v in axes.items())
         lines.append(
             f"run {man.get('run_id')}  world {man.get('world')}  "
             f"{man.get('trainer', '?')}  "
             f"[{plat.get('backend', '?')} x{plat.get('device_count', '?')}"
             f"{' ' + plat['device_kind'] if plat.get('device_kind') else ''}]"
-            f"  started {_age(man.get('time'), now)}"
+            + (f"  mesh {mesh_s}" if mesh_s else "")
+            + (f"  rules {part['rules']}" if part.get("rules") else "")
+            + f"  started {_age(man.get('time'), now)}"
         )
     else:
         lines.append(f"(no manifest yet under {state['dir']})")

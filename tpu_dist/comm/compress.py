@@ -161,6 +161,36 @@ def resolve(config_value=None) -> CompressConfig | None:
     return parse(os.environ.get(ENV_COMPRESS))
 
 
+def refuse_model_axes(
+    where: str,
+    axes,
+    *,
+    rules: str | None = None,
+    hint: str | None = None,
+) -> None:
+    """Raise the model-sharding refusal with its CAUSE attached: the
+    compressed wire reduces over the pure data axis only, and a bare
+    "not supported" hides which axis (and which mode / partition rule)
+    put the gradient on a model-sharded layout.  ``axes`` names the
+    offending mesh axes; ``rules`` names the trainer mode or partition
+    rule set that produced them."""
+    axes = tuple(axes)
+    axes_s = (
+        f"model-sharded ax{'is' if len(axes) == 1 else 'es'} "
+        + ", ".join(repr(a) for a in axes)
+        if axes
+        else "a model-sharded gradient layout"
+    )
+    raise ValueError(
+        f"{where}: grad_compress compresses the pure data-axis gradient "
+        f"sync only; {axes_s}"
+        + (f" (produced by {rules})" if rules else "")
+        + " cannot ride the quantized wire — drop grad_compress or the "
+        "model-sharding axes"
+        + (f". {hint}" if hint else "")
+    )
+
+
 # ---------------------------------------------------------------------------
 # Flat bucket layout
 # ---------------------------------------------------------------------------

@@ -63,7 +63,12 @@ STEP_REQUIRED = (
 SCHEMA: dict[str, tuple[str, ...]] = {
     "manifest": ("world", "platform", "mesh", "config"),
     "step": STEP_REQUIRED,
-    "epoch": ("epoch", "mean_loss", "seconds", "goodput", "bubble_fraction"),
+    # "mesh" = partition provenance: {"axes": {name: size}, "rules":
+    # <active partition rule-set name or null>} — WHAT sharded the run
+    "epoch": (
+        "epoch", "mean_loss", "seconds", "goodput", "bubble_fraction",
+        "mesh",
+    ),
     "checkpoint": ("path", "epoch", "seconds"),
     "retry": ("what", "attempt", "max_attempts", "error"),
     "chaos": ("clause",),

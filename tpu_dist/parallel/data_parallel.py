@@ -256,10 +256,11 @@ def make_stateful_train_step(
                 f"grad_reduce='psum', not {grad_reduce!r}"
             )
         if extra_grad_axes or grad_psum_axes:
-            raise ValueError(
-                "grad_compress supports the pure data-axis reduction only; "
-                "model-axis gradient contracts (extra_grad_axes/"
-                "grad_psum_axes) are not compressed"
+            compress_mod.refuse_model_axes(
+                "make_stateful_train_step",
+                tuple(extra_grad_axes) + tuple(grad_psum_axes),
+                rules="extra_grad_axes/grad_psum_axes (the TP/pipeline "
+                "gradient contracts)",
             )
     # EF threads a residual through the opt-state slot; without EF the
     # compressed reduce is stateless and the contract is unchanged.

@@ -77,10 +77,10 @@ def _compress_setup(grad_compress, grad_pmean_axes, builder: str):
 
     ccfg = compress_mod.parse(grad_compress)
     if ccfg is not None and grad_pmean_axes:
-        raise ValueError(
-            f"{builder}: grad_compress supports the pure data-axis "
-            "reduce-scatter only; grad_pmean_axes (TP composition) is "
-            "not compressed"
+        compress_mod.refuse_model_axes(
+            builder,
+            grad_pmean_axes,
+            rules="grad_pmean_axes (the TP gradient contract)",
         )
     return ccfg, ccfg is not None and ccfg.error_feedback
 

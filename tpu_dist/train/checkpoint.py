@@ -47,13 +47,17 @@ def _tree_digest(paths: list[str], arrays: list[np.ndarray]) -> str:
     return h.hexdigest()
 
 
-def save(path: str | Path, tree: Any, *, step: int = 0) -> None:
+def save(
+    path: str | Path, tree: Any, *, step: int = 0, partition: dict | None = None
+) -> None:
     """Single-writer save of a (replicated) pytree.  Only process 0 writes
     in a multi-process setting — replicas are identical (SURVEY.md §2c.6).
 
     ``__meta__`` carries a sha256 digest of the leaf bytes; `restore`
     verifies it, and `latest_intact` uses it to skip truncated/corrupt
-    snapshots when picking a resume point."""
+    snapshots when picking a resume point.  ``partition`` (the resolved
+    partition-rule provenance, `parallel.partition_summary`) rides the
+    meta so restore can validate mesh compatibility (`check_partition`)."""
     if jax.process_index() != 0:
         return
     path = Path(path)
@@ -66,6 +70,8 @@ def save(path: str | Path, tree: Any, *, step: int = 0) -> None:
         "paths": paths_,
         "digest": _tree_digest(paths_, list(arrays.values())),
     }
+    if partition is not None:
+        meta["partition"] = partition
     tmp = path.with_suffix(".tmp.npz")
     np.savez(tmp, __meta__=json.dumps(meta), **arrays)
     tmp.rename(path)
@@ -135,15 +141,23 @@ class AsyncCheckpointer:
         self._thread = threading.Thread(target=_write, daemon=True)
         self._thread.start()
 
-    def save(self, path: str | Path, tree: Any, *, step: int = 0) -> None:
+    def save(
+        self, path: str | Path, tree: Any, *, step: int = 0,
+        partition: dict | None = None,
+    ) -> None:
         self.wait()
         if jax.process_index() != 0:
             return
         # Device→host transfer happens NOW; everything after is file IO.
         host_tree = jax.tree.map(np.asarray, jax.device_get(tree))
-        self._submit(lambda: save(path, host_tree, step=step))
+        self._submit(
+            lambda: save(path, host_tree, step=step, partition=partition)
+        )
 
-    def save_sharded(self, path: str | Path, tree: Any, *, step: int = 0) -> None:
+    def save_sharded(
+        self, path: str | Path, tree: Any, *, step: int = 0,
+        partition: dict | None = None,
+    ) -> None:
         """Async `save_sharded`: the device→host shard snapshot happens
         now (so buffers may be donated immediately after); file IO runs
         on the background thread.  Unlike `save`, EVERY process writes
@@ -152,6 +166,8 @@ class AsyncCheckpointer:
         p = Path(path)
         meta_leaves, blobs = _plan_sharded_save(tree, step)
         meta = {"step": step, "leaves": meta_leaves}
+        if partition is not None:
+            meta["partition"] = partition
 
         def _write():
             p.mkdir(parents=True, exist_ok=True)
@@ -479,7 +495,9 @@ def _write_sharded(
             time.sleep(0.05)
 
 
-def save_sharded(path: str | Path, tree: Any, *, step: int = 0) -> None:
+def save_sharded(
+    path: str | Path, tree: Any, *, step: int = 0, partition: dict | None = None
+) -> None:
     """Checkpoint a pytree of (possibly sharded) ``jax.Array``s without
     ever materializing a global array on any host.
 
@@ -495,7 +513,10 @@ def save_sharded(path: str | Path, tree: Any, *, step: int = 0) -> None:
     path = Path(path)
     path.mkdir(parents=True, exist_ok=True)
     meta_leaves, blobs = _plan_sharded_save(tree, step)
-    _write_sharded(path, {"step": step, "leaves": meta_leaves}, blobs)
+    meta = {"step": step, "leaves": meta_leaves}
+    if partition is not None:
+        meta["partition"] = partition
+    _write_sharded(path, meta, blobs)
 
 
 def _read_region(
@@ -542,8 +563,48 @@ def read_meta(path: str | Path) -> dict:
     """The sharded checkpoint's metadata: ``{"step", "leaves": [{"path",
     "shape", "dtype", "shards": [...]}, ...]}`` — lets callers inspect
     saved shapes/dtypes before choosing a restore template (e.g. the
-    FSDP world-resize path in `Trainer.restore`)."""
+    FSDP world-resize path in `Trainer.restore`).  Checkpoints written
+    by the partition-engine trainers additionally carry ``"partition"``
+    (rule-set name + mesh axis names/sizes, `check_partition`)."""
     return json.loads((Path(path) / "meta.json").read_text())
+
+
+def check_partition(
+    meta: dict, expected: dict, *, where: str = "checkpoint"
+) -> None:
+    """Validate a checkpoint's recorded partition provenance against the
+    restoring run's resolved rule set + mesh (both in
+    `parallel.partition_summary` form).  Mismatches raise a clear error
+    instead of the silent mis-shard a blind restore would risk — the
+    groundwork for elastic resume (ROADMAP item 3): a reshape across
+    topologies must be an explicit redistribution, not an accident."""
+    saved = meta.get("partition")
+    if saved is None:
+        raise ValueError(
+            f"{where}: no partition metadata recorded — this checkpoint "
+            "was not written by a partition-engine (mesh_axes) trainer; "
+            "restore it with the trainer mode that wrote it"
+        )
+    saved_axes = dict(saved.get("axes", {}))
+    want_axes = dict(expected.get("axes", {}))
+    problems = []
+    if saved.get("rules") != expected.get("rules"):
+        problems.append(
+            f"rule set {saved.get('rules')!r} (saved) vs "
+            f"{expected.get('rules')!r} (this run)"
+        )
+    if saved_axes != want_axes:
+        problems.append(
+            f"mesh axes {saved_axes} (saved) vs {want_axes} (this run)"
+        )
+    if problems:
+        raise ValueError(
+            f"{where}: partition mismatch — " + "; ".join(problems)
+            + ".  Resharding across meshes is not automatic yet "
+            "(ROADMAP item 3, elastic resume); restore on a matching "
+            "mesh_axes configuration or redistribute the checkpoint "
+            "explicitly via restore_sharded with the new shardings."
+        )
 
 
 def restore_sharded(path: str | Path, like: Any) -> tuple[Any, int]:

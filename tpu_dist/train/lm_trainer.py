@@ -119,6 +119,19 @@ class LMTrainConfig:
     # Drained at every observable boundary, so epoch stats / bad_steps /
     # checkpoints are depth-invariant.
     inflight_steps: int = 2
+    # Partition engine (parallel.partition): a mesh-axes spec like
+    # "dp=8", "zero1:dp=8", "dp=2,fsdp=4", or "dp=2,tp=2" selects a
+    # rule set (regex path -> PartitionSpec, Megatron tp vocabulary for
+    # the transformer layers) and routes training through ONE GSPMD
+    # step: params/opt state sharded per the rules, the weight update
+    # sharded over the data axes, composed 2-D/3-D meshes from one
+    # knob.  The mesh must carry exactly these axes
+    # (partition.build_mesh).  Mutually exclusive with every strategy
+    # flag (fsdp/zero1/tensor/sequence/pipeline/moe) and grad_compress.
+    mesh_axes: str | None = None
+    # Per-model overrides for the engine: (regex, spec) pairs matched
+    # ahead of the built-ins (TPU_DIST_RULES env rules come first).
+    partition_rules: list | None = None
     log: Callable[[str], None] = print
 
 
@@ -155,7 +168,10 @@ class LMTrainer:
                 self.optimizer, self.config.grad_clip
             )
 
-        self._sharded_mode = self.config.fsdp or self.config.zero1
+        self._engine_mode = self.config.mesh_axes is not None
+        self._sharded_mode = (
+            self.config.fsdp or self.config.zero1 or self._engine_mode
+        )
         # Compressed gradient sync: resolved (and VALIDATED — a typo'd
         # wire dtype fails here, not at trace time) from config or the
         # TPU_DIST_COMPRESS env var.
@@ -170,6 +186,41 @@ class LMTrainer:
         # (sharded P(data)), which the single-writer npz cannot hold on
         # a multi-process mesh.
         self._sharded_ckpt = self._sharded_mode or self._wrap_ef
+        # Partition-engine mode: rule set resolved (and the mesh
+        # validated against the spec) at config time.
+        self._ruleset = None
+        self._partition_meta = None
+        if self._engine_mode:
+            if self.config.fsdp or self.config.zero1:
+                raise ValueError(
+                    "mesh_axes selects a partition rule set — it replaces "
+                    "the fsdp/zero1 strategy flags, do not combine them"
+                )
+            if (
+                self.config.tensor_parallel is not None
+                or self.config.sequence_parallel is not None
+                or self.config.pipeline is not None
+                or self.config.moe
+            ):
+                raise ValueError(
+                    "mesh_axes is a rule-set mode of its own — tensor/"
+                    "sequence/pipeline/moe flags select the strategy step "
+                    "builders instead; express tp composition as a 'tp' "
+                    "axis in mesh_axes (e.g. 'dp=2,tp=2')"
+                )
+            if self.config.loss_scale is not None:
+                raise ValueError(
+                    "loss_scale is not threaded through the partitioned "
+                    "step — use nan_guard without loss_scale under "
+                    "mesh_axes"
+                )
+            self._ruleset, self._partition_meta = (
+                parallel.resolve_trainer_rules(
+                    "LMTrainer(mesh_axes=...)", mesh, self.config.mesh_axes,
+                    user_rules=self.config.partition_rules,
+                    compress=self._compress,
+                )
+            )
         if self.config.loss_scale is not None and not self.config.nan_guard:
             raise ValueError("loss_scale requires nan_guard=True")
         if self.config.nan_guard:
@@ -207,11 +258,16 @@ class LMTrainer:
         if self._compress is not None and (
             tp is not None or sp is not None or pp is not None or moe
         ):
-            raise ValueError(
-                "grad_compress compresses the pure data-axis gradient "
-                "sync only — not combinable with tensor/sequence/"
-                "pipeline/moe model sharding"
-            )
+            mode_axes, mode = [], None
+            if tp is not None:
+                mode_axes, mode = [self.config.model_axis], f"tensor_parallel={tp!r}"
+            elif sp is not None:
+                mode_axes, mode = [self.config.seq_axis], f"sequence_parallel={sp!r}"
+            elif pp is not None:
+                mode_axes, mode = [self.config.pipe_axis], f"pipeline={pp!r}"
+            elif moe:
+                mode = "moe=True (expert all_to_all over the data axis)"
+            compress_mod.refuse_model_axes("LMTrainer", mode_axes, rules=mode)
         if moe:
             world_data = mesh.shape.get(parallel.DATA_AXIS)
             if getattr(lm, "moe_experts", 0) != world_data:
@@ -357,13 +413,40 @@ class LMTrainer:
         # AND sequence for the Megatron-SP and sequence-parallel modes,
         # batch only otherwise.  fit()/both step builders all use this.
         self._batch_spec = (
-            P(parallel.DATA_AXIS, self.config.model_axis)
+            self._ruleset.batch_spec()
+            if self._ruleset is not None
+            else P(parallel.DATA_AXIS, self.config.model_axis)
             if tp == "sp"
             else P(parallel.DATA_AXIS, self.config.seq_axis)
             if sp is not None
             else None
         )
-        if self._sharded_mode:
+        if self._engine_mode:
+            # Partition-engine path: the DENSE loss on the global batch;
+            # XLA's SPMD partitioner derives the per-device program and
+            # collectives from the rule-matched shardings (tp rules give
+            # the Megatron layout without a tensor-parallel loss fn).
+            def engine_loss(p, batch, key):
+                (tokens,) = batch
+                logits, _ = self.lm.apply(cast(p), {}, tokens)
+                return lm_loss(logits.astype(jnp.float32), tokens), {}
+
+            built = parallel.make_partitioned_train_step(
+                engine_loss, self.optimizer, mesh, params, self._ruleset,
+                accum_steps=self.config.accum_steps,
+            )
+            self.params, self.opt_state = built.params, built.opt_state
+            self._param_template = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+            )
+            self._partition = built
+
+            def engine_step(p, ms, os_, batch, key):
+                p2, o2, loss, aux = built.step(p, os_, batch, key)
+                return p2, ms, o2, loss, aux
+
+            self.step = engine_step
+        elif self._sharded_mode:
             def fsdp_loss(p, batch, key):
                 (tokens,) = batch
                 return mode_loss(p, tokens), {}
@@ -456,7 +539,12 @@ class LMTrainer:
 
     def _full_params(self):
         """Full (logical-shape) parameters for eval/decode — identity for
-        the replicated path, shard reassembly under FSDP."""
+        the replicated path, shard reassembly under FSDP, a compiled
+        all-gather for rule-sharded engine state on multi-process meshes
+        (fully-addressable engine shards pass through — jnp reads them
+        directly)."""
+        if self._engine_mode:
+            return parallel.gather_replicated(self.params, self.mesh)
         if not self.config.fsdp:
             return self.params
         return parallel.fsdp_full_params(
@@ -492,7 +580,8 @@ class LMTrainer:
         # Opt-in telemetry (TPU_DIST_TELEMETRY): manifest + per-step JSONL
         # events, heartbeat, host spans, goodput — see docs/observability.md.
         telemetry = metrics_mod.TrainTelemetry(
-            world=self.world, mesh=self.mesh, config=cfg, trainer="LMTrainer"
+            world=self.world, mesh=self.mesh, config=cfg, trainer="LMTrainer",
+            partition=self._partition_meta,
         )
         telemetry.set_compress(self._compress_summary)
         telemetry.set_pipeline(self._pipe_summary)
@@ -606,7 +695,10 @@ class LMTrainer:
                         with telemetry.goodput.measure("checkpoint") as ck:
                             if self._sharded_ckpt:
                                 path = f"{checkpoint_dir}/lm_ckpt_preempt"
-                                ckpt_mod.save_sharded(path, tree, step=epoch)
+                                ckpt_mod.save_sharded(
+                                    path, tree, step=epoch,
+                                    partition=self._partition_meta,
+                                )
                             else:
                                 path = f"{checkpoint_dir}/lm_ckpt_preempt.npz"
                                 ckpt_mod.save(path, tree, step=epoch)
@@ -662,7 +754,10 @@ class LMTrainer:
                             # sharded format = a DIRECTORY of shard files — no
                             # .npz suffix (ADVICE r2: a dir named .npz misleads)
                             path = f"{checkpoint_dir}/lm_ckpt_{epoch}"
-                            writer.save_sharded(path, tree, step=epoch + 1)
+                            writer.save_sharded(
+                                path, tree, step=epoch + 1,
+                                partition=self._partition_meta,
+                            )
                         else:
                             path = f"{checkpoint_dir}/lm_ckpt_{epoch}.npz"
                             writer.save(path, tree, step=epoch + 1)
@@ -677,6 +772,13 @@ class LMTrainer:
 
         like = {"params": self.params, "opt_state": self.opt_state}
         if self._sharded_ckpt:
+            if self._ruleset is not None:
+                # Engine mode: a checkpoint from a different rule set or
+                # mesh must fail loudly, not flat-copy into garbage.
+                checkpoint.check_partition(
+                    checkpoint.read_meta(path), self._partition_meta,
+                    where=f"restore({path})",
+                )
             # Rebuilt under the templates' shardings — replicated leaves
             # come back replicated, the EF residual comes back P(data).
             state, epoch = checkpoint.restore_fsdp(path, like)

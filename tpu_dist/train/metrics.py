@@ -132,7 +132,9 @@ class TrainTelemetry:
     exported when ``TPU_DIST_METRICS_PORT`` is set).  Constructing one
     emits the run manifest (config/mesh/platform provenance)."""
 
-    def __init__(self, *, world: int, mesh, config, trainer: str):
+    def __init__(
+        self, *, world: int, mesh, config, trainer: str, partition=None
+    ):
         from tpu_dist import observe
 
         self.events = observe.events.from_env()
@@ -178,9 +180,18 @@ class TrainTelemetry:
         self._compiled = False
         self._flops: float | None = None
         self._flops_captured = False
+        # Mesh/rule-set provenance: the partition-engine summary when
+        # one is active, otherwise the mesh axes alone (rules: null) —
+        # every epoch event carries it, so an operator can tell WHAT
+        # sharded a run without reading the config.
+        self._partition_summary = partition or {
+            "rules": None,
+            "axes": observe.events.mesh_summary(mesh).get("shape", {}),
+        }
         if self.enabled:
             self.events.manifest(
-                world=world, config=config, mesh=mesh, trainer=trainer
+                world=world, config=config, mesh=mesh, trainer=trainer,
+                partition=self._partition_summary,
             )
 
     @property
@@ -448,6 +459,7 @@ class TrainTelemetry:
                 goodput=self.goodput.summary(),
                 bubble_fraction=self.bubble_fraction,
                 pipeline=self._pipe_summary,
+                mesh=self._partition_summary,
                 **extra,
             )
 

@@ -93,6 +93,22 @@ class TrainConfig:
     # checkpointed state are bit-identical whatever the depth
     # (tests/test_pipeline_driver.py).
     inflight_steps: int = 2
+    # Partition engine (parallel.partition): a mesh-axes spec like
+    # "dp=8", "zero1:dp=8", "fsdp=8", or "dp=2,fsdp=4" selects a
+    # rule set (regex path -> PartitionSpec) and routes training through
+    # ONE GSPMD train step — params/opt-state sharded per the rules, the
+    # weight update sharded over the data axes (ZeRO-1 for free), every
+    # collective derived by XLA.  The mesh passed to the Trainer must
+    # carry exactly these axes (partition.build_mesh builds one).
+    # Mutually exclusive with fsdp/zero1/grad_compress/loss_scale;
+    # checkpoints use the sharded directory format with partition
+    # provenance recorded in the meta (restore validates it).
+    mesh_axes: str | None = None
+    # Per-model overrides for the engine: list of (regex, spec) pairs
+    # matched AHEAD of the built-in rules (spec = PartitionSpec or a
+    # string like "None,tp"); the TPU_DIST_RULES env var prepends
+    # further rules ahead of these.  Ignored without mesh_axes.
+    partition_rules: list | None = None
 
 
 @dataclass
@@ -139,6 +155,36 @@ class Trainer:
                 "grad_compress replaces the gradient reduce — leave "
                 f"grad_reduce='psum', not {self.config.grad_reduce!r}"
             )
+        # Partition-engine mode: the rule set is resolved (and the mesh
+        # validated against the spec) at CONFIG time, so a typo'd axis
+        # or a mis-shaped mesh fails here, not at trace time.
+        self._ruleset = None
+        self._partition_meta = None
+        if self.config.mesh_axes is not None:
+            if self.config.fsdp or self.config.zero1:
+                raise ValueError(
+                    "mesh_axes selects a partition rule set — it replaces "
+                    "the fsdp/zero1 strategy flags, do not combine them"
+                )
+            if self.config.grad_reduce != "psum":
+                raise ValueError(
+                    "mesh_axes routes the gradient sync through the XLA "
+                    f"partitioner; grad_reduce={self.config.grad_reduce!r} "
+                    "only applies to the strategy step builders"
+                )
+            if self.config.loss_scale is not None:
+                raise ValueError(
+                    "loss_scale is not threaded through the partitioned "
+                    "step — use nan_guard without loss_scale under "
+                    "mesh_axes"
+                )
+            self._ruleset, self._partition_meta = (
+                parallel.resolve_trainer_rules(
+                    "Trainer(mesh_axes=...)", mesh, self.config.mesh_axes,
+                    user_rules=self.config.partition_rules,
+                    compress=self._compress,
+                )
+            )
         if self.config.loss_scale is not None and not self.config.nan_guard:
             raise ValueError("loss_scale requires nan_guard=True")
         if self.config.nan_guard:
@@ -171,8 +217,8 @@ class Trainer:
             raise ValueError("fsdp and zero1 are mutually exclusive")
         if sharded_mode and jax.tree.leaves(state):
             raise ValueError(
-                "TrainConfig.fsdp/zero1 support stateless models only (no "
-                "BatchNorm running stats); use "
+                "TrainConfig.fsdp/zero1/mesh_axes support stateless models "
+                "only (no BatchNorm running stats); use "
                 "parallel.make_fsdp_train_step directly for custom state"
             )
         if not sharded_mode:
@@ -227,7 +273,34 @@ class Trainer:
             scores, new_state = forward(params, model_state, x, key)
             return self._loss(scores, y), (new_state, {})
 
-        if sharded_mode:
+        if self._ruleset is not None:
+            # Partition-engine path: ONE GSPMD step for any rule set —
+            # the loss is the GLOBAL computation (mean over the global
+            # batch) and XLA derives the per-device program + every
+            # collective from the rule-matched shardings; the same
+            # 5-tuple wrapper keeps fit() oblivious.
+            def engine_loss(p, batch, key):
+                x, y = batch
+                scores, _ = forward(p, state, x, key)
+                return self._loss(scores, y), {}
+
+            built = parallel.make_partitioned_train_step(
+                engine_loss, self.optimizer, mesh, params, self._ruleset,
+                accum_steps=self.config.accum_steps,
+            )
+            self.params, self.opt_state = built.params, built.opt_state
+            self.model_state = parallel.replicate(state, mesh)
+            self._param_template = jax.tree.map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
+            )
+            self._partition = built
+
+            def engine_step(p, ms, os_, batch, key):
+                p2, o2, loss, aux = built.step(p, os_, batch, key)
+                return p2, ms, o2, loss, aux
+
+            self.step = engine_step
+        elif sharded_mode:
             # ZeRO path: optimizer state (and, for fsdp, params) live
             # permanently sharded; the step wrapper keeps the stateful
             # 5-tuple contract so fit()/callers are oblivious to the
@@ -288,8 +361,14 @@ class Trainer:
     @property
     def _sharded_mode(self) -> bool:
         """Single owner of the sharded-vs-replicated format dispatch —
-        save/restore/fit must all agree on it."""
-        return self.config.fsdp or self.config.zero1
+        save/restore/fit must all agree on it.  The partition engine
+        (mesh_axes) counts: its params/opt state may live sharded, so
+        checkpoints take the per-shard directory format."""
+        return (
+            self.config.fsdp
+            or self.config.zero1
+            or self.config.mesh_axes is not None
+        )
 
     @property
     def _sharded_ckpt(self) -> bool:
@@ -322,11 +401,17 @@ class Trainer:
         tree = self._ckpt_tree()
         if self._sharded_ckpt:
             # Per-shard files, no global array materialized (``path``
-            # becomes a directory — see checkpoint.save_sharded).
+            # becomes a directory — see checkpoint.save_sharded).  The
+            # partition-engine trainer records its resolved rule set +
+            # mesh axes so restore can validate compatibility.
             if async_writer is not None:
-                async_writer.save_sharded(path, tree, step=epoch)
+                async_writer.save_sharded(
+                    path, tree, step=epoch, partition=self._partition_meta
+                )
             else:
-                checkpoint.save_sharded(path, tree, step=epoch)
+                checkpoint.save_sharded(
+                    path, tree, step=epoch, partition=self._partition_meta
+                )
             return
         if async_writer is not None:
             async_writer.save(path, tree, step=epoch)
@@ -341,6 +426,13 @@ class Trainer:
 
         like = self._ckpt_tree()
         if self._sharded_ckpt:
+            if self._ruleset is not None:
+                # Engine mode: a checkpoint from a different rule set or
+                # mesh must fail loudly, not flat-copy into garbage.
+                checkpoint.check_partition(
+                    checkpoint.read_meta(path), self._partition_meta,
+                    where=f"restore({path})",
+                )
             # Rebuilt under the templates' shardings — replicated leaves
             # come back replicated, the EF residual comes back P(data).
             restored, epoch = checkpoint.restore_fsdp(path, like)
@@ -407,7 +499,8 @@ class Trainer:
         # Opt-in telemetry (TPU_DIST_TELEMETRY): manifest + per-step JSONL
         # events, heartbeat, host spans, goodput — see docs/observability.md.
         telemetry = metrics_mod.TrainTelemetry(
-            world=self.world, mesh=self.mesh, config=cfg, trainer="Trainer"
+            world=self.world, mesh=self.mesh, config=cfg, trainer="Trainer",
+            partition=self._partition_meta,
         )
         telemetry.set_compress(self._compress_summary)
         ok = False
@@ -453,6 +546,13 @@ class Trainer:
                     with HostLoader(
                         loader.epoch(epoch), self.mesh,
                         axis_name=self.mesh.axis_names[0],
+                        # engine mode: the batch shards over the rule
+                        # set's data axes (e.g. dp AND fsdp)
+                        spec=(
+                            self._ruleset.batch_spec()
+                            if self._ruleset is not None
+                            else None
+                        ),
                     ) as batches:
                         for bi in range(loader.steps_per_epoch):
                             with telemetry.spans.span(
@@ -576,6 +676,10 @@ class Trainer:
                 self.params, self._param_template, self.mesh,
                 parallel.DATA_AXIS,  # the axis make_fsdp_train_step sharded over
             )
+        elif self._ruleset is not None:
+            # engine mode: rule-sharded params all-gather once when any
+            # shard is non-addressable (identity on one process)
+            eval_params = parallel.gather_replicated(self.params, self.mesh)
         # Eval batches ride the same prefetch pipeline as training: the
         # pad/stack assembly and H2D transfer for batch i+1 overlap the
         # compiled apply of batch i (labels stay on the host — only the
