@@ -10,10 +10,17 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
         kernels decode serve lm-train overlap parity figures \
         scaling multiproc longcontext train-lm train-lm-modes generate \
         chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
-        bench-pipeline bench-decode bench-serve serve-demo bench-mesh
+        bench-pipeline bench-decode bench-serve serve-demo bench-mesh \
+        analyze analyze-bless
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+analyze:  # static analyzer: lints + golden collective-plan gate (CI job)
+	$(PY) -m tpu_dist.analysis
+
+analyze-bless:  # regenerate the golden CollectivePlans under tests/goldens/
+	$(PY) -m tpu_dist.analysis --bless
 
 telemetry-demo:  # short traced training run; asserts the events file parses
 	cd demos && $(PY) telemetry_demo.py --platform $(PLATFORM) --world 4

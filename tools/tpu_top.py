@@ -89,6 +89,7 @@ def empty_state(dirpath: str) -> dict:
         "counts": {},
         "beats": {},
         "serve": None,     # last decode_step record (serving runs)
+        "analysis": None,  # last static-analyzer summary (make analyze)
     }
 
 
@@ -107,6 +108,8 @@ def update(state: dict, records: list) -> dict:
             state["epochs"].append(rec)
         elif kind == "decode_step":
             state["serve"] = rec
+        elif kind == "analysis":
+            state["analysis"] = rec
         if kind in NOTABLE:
             state["notable"].append(rec)
             del state["notable"][:-64]  # bounded; render shows the tail
@@ -183,6 +186,22 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
             f" ({_fmt(sv.get('kv_block_utilization'), '.0%')})"
             f"  finished {state['counts'].get('request_finish', 0)}"
             f"  ({_age(sv.get('time'), now)})"
+        )
+
+    an = state.get("analysis")
+    if an:
+        # static-analyzer status (tpu_dist.analysis): lint findings per
+        # rule + the golden collective-plan gate, alongside mesh/rules
+        findings = an.get("findings") or {}
+        f_s = (
+            ",".join(f"{k}={v}" for k, v in sorted(findings.items()))
+            if findings else "none"
+        )
+        lines.append(
+            f"analysis  programs {_fmt(an.get('programs'))}"
+            f"  findings {f_s}"
+            f"  goldens {an.get('golden') or '--'}"
+            f"  ({_age(an.get('time'), now)})"
         )
 
     if state["epochs"]:

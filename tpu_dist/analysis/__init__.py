@@ -1,0 +1,67 @@
+"""`tpu_dist.analysis` — static analysis of compiled SPMD programs.
+
+Three layers (see docs/analysis.md):
+
+- `plan`: collective-plan extraction from compiled HLO (`extract_plan`
+  → `CollectivePlan` with axis names recovered from replica groups),
+  `diff_plans` for engine-vs-legacy comparison, and golden-file
+  persistence (`save_golden` / `compare_to_golden`).
+- `lints`: the lint rules (`run_lints`, `Finding`) — host transfers,
+  missing donation, compressed-wire escapes, dead/fallthrough partition
+  rules, replicated residency, reused PRNG keys.
+- `programs`: the canonical entry-program registry
+  (`canonical_program`) the CLI and CI gate run over.
+
+CLI: ``python -m tpu_dist.analysis`` (``make analyze`` /
+``make analyze-bless``).
+"""
+
+from tpu_dist.analysis.lints import (
+    ALL_LINTS,
+    Finding,
+    donated_buffer_count,
+    find_callbacks,
+    find_reused_keys,
+    run_lints,
+)
+from tpu_dist.analysis.plan import (
+    Collective,
+    CollectivePlan,
+    compare_to_golden,
+    compiled_text,
+    diff_plans,
+    extract_plan,
+    load_golden,
+    parse_hlo_collectives,
+    save_golden,
+)
+from tpu_dist.analysis.programs import (
+    CANONICAL,
+    PINNED_PAIRS,
+    AnalysisProgram,
+    canonical_program,
+    canonical_programs,
+)
+
+__all__ = [
+    "ALL_LINTS",
+    "AnalysisProgram",
+    "CANONICAL",
+    "Collective",
+    "CollectivePlan",
+    "Finding",
+    "PINNED_PAIRS",
+    "canonical_program",
+    "canonical_programs",
+    "compare_to_golden",
+    "compiled_text",
+    "diff_plans",
+    "donated_buffer_count",
+    "extract_plan",
+    "find_callbacks",
+    "find_reused_keys",
+    "load_golden",
+    "parse_hlo_collectives",
+    "run_lints",
+    "save_golden",
+]

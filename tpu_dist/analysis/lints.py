@@ -1,0 +1,508 @@
+"""Lint rules over jaxprs, compiled HLO, and partition metadata.
+
+Each lint encodes one invariant the repo's performance/correctness story
+depends on but that nothing used to CHECK mechanically:
+
+- ``host-transfer``      — no host callbacks / infeed / outfeed inside a
+                           jitted hot path (a `jax.debug.print` left in a
+                           train step serializes every device step
+                           through Python).
+- ``missing-donation``   — a hot-loop step that re-binds its state every
+                           iteration must donate the old buffers, or
+                           peak memory doubles silently.
+- ``compress-wire``      — under `comm.compress`, every wide collective
+                           operand must ride the 1-byte (or configured)
+                           wire; a 4-byte gradient payload collective
+                           means the compressed path silently fell back
+                           to exact sync.
+- ``dead-rule``          — a USER partition rule matching zero leaves is
+                           a typo'd pattern whose layer silently fell
+                           through to the built-ins.
+- ``replicated-fallthrough`` — under a model-sharded (tp) rule set, a
+                           large leaf that only the catch-all matched
+                           and that ended up replicated: the rule
+                           vocabulary doesn't know this parameter.
+- ``replicated-residency`` — under fsdp (params+opt) / zero1 (opt) rule
+                           sets, a large shardable leaf living fully
+                           replicated defeats the memory story the rule
+                           set exists for.
+- ``reused-prng-key``    — the same PRNG key consumed by two samplers in
+                           one traced fn produces correlated "random"
+                           numbers; keys must be `split`/`fold_in`-
+                           derived per use.
+
+`run_lints(program)` runs every applicable lint over one
+`programs.AnalysisProgram`; each lint is also usable standalone on raw
+(fn, args) pairs via the jaxpr/HLO helpers.  Findings are data
+(`Finding`), so tests can seed one violation per lint and assert exactly
+that finding fires — and the CLI can gate CI on an empty list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from tpu_dist.analysis.plan import MINOR_ELEMS, itemsize
+
+# Leaves below this many elements never trigger the residency /
+# fallthrough lints — biases and norm scales are replicated by design.
+BIG_LEAF_ELEMS = 4096
+
+# jaxpr primitives that CONSUME a PRNG key (draw bits from it), vs the
+# DERIVATION primitives that mint new keys and are safe to call many
+# times on one parent key.
+_SAMPLERS = frozenset({"random_bits", "random_gamma"})
+_DERIVERS = frozenset({
+    "random_split", "random_fold_in", "random_clone", "random_unwrap",
+})
+
+# jaxpr primitives that round-trip through the host.
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "host_callback",
+    "outside_call", "debug_print",
+})
+
+# HLO ops / custom-call targets that stage through the host.
+HOST_OPS = ("infeed", "outfeed", "copy-to-host", "copy-from-host")
+_CALLBACK_TARGETS = ("callback", "host")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint hit.  ``severity`` is 'error' (CI-gating) or 'warning'."""
+
+    lint: str
+    program: str
+    message: str
+    severity: str = "error"
+    detail: dict = field(default_factory=dict, compare=False)
+
+    def __str__(self) -> str:
+        return f"[{self.lint}] {self.program}: {self.message}"
+
+
+# ------------------------------------------------------- jaxpr traversal
+
+
+def _subjaxprs(eqn):
+    """(closed) jaxprs hiding in an eqn's params, with a best-effort map
+    of eqn operand positions -> subjaxpr invar positions."""
+    prim = eqn.primitive.name
+    found = []
+    for value in eqn.params.values():
+        vals = value if isinstance(value, (tuple, list)) else (value,)
+        for v in vals:
+            jx = getattr(v, "jaxpr", None)
+            if jx is None and hasattr(v, "eqns"):
+                jx = v
+            if jx is not None and hasattr(jx, "eqns"):
+                found.append(jx)
+    maps = []
+    for jx in found:
+        n_in = len(jx.invars)
+        if prim in ("cond", "switch"):
+            # first eqn operand is the branch index
+            offsets = list(range(1, 1 + n_in))
+        else:
+            # pjit / closed_call / scan / while / custom_* bind their
+            # operands 1:1 (tail-aligned when lengths differ)
+            offsets = list(range(len(eqn.invars) - n_in, len(eqn.invars)))
+        maps.append((jx, offsets))
+    return maps
+
+
+def _walk_jaxprs(jaxpr, visit, scope=()):
+    """Depth-first over a jaxpr and every subjaxpr; ``visit(jaxpr,
+    scope)`` per jaxpr, scope = tuple of enclosing call names."""
+    visit(jaxpr, scope)
+    for eqn in jaxpr.eqns:
+        name = eqn.params.get("name") or eqn.primitive.name
+        for sub, _ in _subjaxprs(eqn):
+            _walk_jaxprs(sub, visit, scope + (str(name),))
+
+
+def _is_key_var(v) -> bool:
+    try:
+        import jax
+
+        return jax.dtypes.issubdtype(v.aval.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def _key_consumption(jaxpr, reused: list, scope=()):
+    """Per-invar consumption counts for one jaxpr, recursing through
+    call-like primitives; appends (scope, var, count) to ``reused`` for
+    every var consumed more than once WITHIN one scope."""
+    counts: dict[Any, int] = {}
+    alias: dict[Any, Any] = {}
+
+    def root(v):
+        while v in alias:
+            v = alias[v]
+        return v
+
+    def bump(v, n=1):
+        v = root(v)
+        counts[v] = counts.get(v, 0) + n
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in _SAMPLERS:
+            for v in eqn.invars:
+                if hasattr(v, "aval") and (
+                    _is_key_var(v) or root(v) is not v
+                ):
+                    bump(v)
+        elif prim == "random_wrap":
+            # u32 raw key -> typed key: consumption of the wrapped key
+            # attributes back to the raw operand
+            if eqn.invars and eqn.outvars:
+                alias[eqn.outvars[0]] = eqn.invars[0]
+        elif prim in _DERIVERS:
+            pass  # deriving new keys is the SAFE way to reuse a parent
+        else:
+            subs = _subjaxprs(eqn)
+            name = str(eqn.params.get("name") or prim)
+            for sub, offsets in subs:
+                inner = _key_consumption(sub, reused, scope + (name,))
+                for pos, n in inner.items():
+                    if n and 0 <= offsets[pos] < len(eqn.invars):
+                        v = eqn.invars[offsets[pos]]
+                        if hasattr(v, "aval"):
+                            bump(v, n)
+    invar_counts = {}
+    for i, v in enumerate(jaxpr.invars):
+        invar_counts[i] = counts.pop(root(v), 0)
+    for v, n in counts.items():
+        if n > 1:
+            reused.append((scope, str(v), n))
+    # an invar consumed >1 time inside THIS jaxpr is reported by the
+    # caller (it owns the var's name) — unless this is the top level
+    for i, n in invar_counts.items():
+        if n > 1 and scope == ():
+            reused.append((scope, f"arg{i}", n))
+    return invar_counts
+
+
+def find_reused_keys(fn, args) -> list[dict]:
+    """Key-reuse sites of a traceable fn on example args: the same PRNG
+    key var feeding ≥2 sampling primitives within one traced scope
+    (derivation via split/fold_in does not count)."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    reused: list = []
+    _key_consumption(jaxpr, reused)
+    return [
+        {"scope": "/".join(scope) or "<top>", "var": var, "uses": n}
+        for scope, var, n in reused
+    ]
+
+
+def find_callbacks(fn, args) -> list[str]:
+    """Host-callback primitives anywhere in the traced jaxpr."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    hits: list[str] = []
+
+    def visit(jx, scope):
+        for eqn in jx.eqns:
+            if eqn.primitive.name in _CALLBACK_PRIMS:
+                hits.append(
+                    ("/".join(scope) or "<top>") + ":" + eqn.primitive.name
+                )
+
+    _walk_jaxprs(jaxpr, visit)
+    return hits
+
+
+# ---------------------------------------------------------------- lints
+
+
+def lint_host_transfer(prog) -> list[Finding]:
+    """No host round-trips inside the compiled hot path: callback
+    primitives in the jaxpr, host ops / callback custom-calls in the
+    HLO."""
+    findings = []
+    for hit in find_callbacks(prog.fn, prog.args):
+        findings.append(
+            Finding(
+                lint="host-transfer",
+                program=prog.name,
+                message=f"host callback in traced fn: {hit}",
+            )
+        )
+    txt = prog.hlo_text
+    for op in HOST_OPS:
+        n = len([
+            line for line in txt.splitlines()
+            if f" {op}(" in line or f" {op}-start(" in line
+        ])
+        if n:
+            findings.append(
+                Finding(
+                    lint="host-transfer",
+                    program=prog.name,
+                    message=f"{n} {op} op(s) in the compiled program",
+                )
+            )
+    for line in txt.splitlines():
+        if "custom-call" not in line or "custom_call_target=" not in line:
+            continue
+        target = line.split('custom_call_target="', 1)[-1].split('"', 1)[0]
+        if any(t in target.lower() for t in _CALLBACK_TARGETS):
+            findings.append(
+                Finding(
+                    lint="host-transfer",
+                    program=prog.name,
+                    message=f"host-callback custom-call: {target}",
+                )
+            )
+    return findings
+
+
+def donated_buffer_count(hlo_text: str) -> int:
+    """Input buffers the compiled module aliases to outputs (the
+    ``input_output_alias={ {0}: (0, {}, may-alias), ... }`` header
+    donation produces) — brace-matched, since the entries themselves
+    contain nested braces."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return 0
+    i = hlo_text.find("{", start)
+    depth = 0
+    for j in range(i, len(hlo_text)):
+        ch = hlo_text[j]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return hlo_text[i: j + 1].count("-alias")
+    return 0
+
+
+def lint_donation(prog) -> list[Finding]:
+    """A program declared as a donating hot loop must actually alias its
+    state buffers in the compiled module."""
+    if not getattr(prog, "expect_donation", False):
+        return []
+    n = donated_buffer_count(prog.hlo_text)
+    want = getattr(prog, "donated_leaves", None)
+    if n == 0:
+        return [
+            Finding(
+                lint="missing-donation",
+                program=prog.name,
+                message=(
+                    "hot-loop state is not donated: compiled module "
+                    "aliases no input buffer (peak memory holds both "
+                    "old and new state)"
+                ),
+            )
+        ]
+    if want is not None and n < want:
+        return [
+            Finding(
+                lint="missing-donation",
+                program=prog.name,
+                message=(
+                    f"only {n} of {want} hot-loop buffers donated "
+                    "(partial aliasing — some state still double-buffers)"
+                ),
+                severity="warning",
+                detail={"aliased": n, "expected": want},
+            )
+        ]
+    return []
+
+
+def lint_compress_wire(prog) -> list[Finding]:
+    """Under grad compression every wide collective operand must carry
+    the configured wire dtype; anything wider-typed and larger than the
+    per-bucket scales is a payload that escaped the compressed wire."""
+    if getattr(prog, "compress", None) is None:
+        return []
+    expect = prog.compress_expectations
+    max_wide = expect["max_wide_operand_elems"]
+    wire_size = expect["wire_itemsize"]
+    findings = []
+    for c in prog.plan:
+        for dt, shape in zip(c.dtypes, c.shapes):
+            elems = int(np.prod(shape)) if shape else 1
+            if itemsize(dt) > wire_size and elems > max_wide:
+                findings.append(
+                    Finding(
+                        lint="compress-wire",
+                        program=prog.name,
+                        message=(
+                            f"{c.kind} ships a {dt}[{','.join(map(str, shape))}] "
+                            f"operand ({elems} elems) — gradient payload "
+                            f"off the {expect['wire']} wire (scales cap: "
+                            f"{max_wide} elems)"
+                        ),
+                        detail={"kind": c.kind, "dtype": dt,
+                                "elems": elems},
+                    )
+                )
+    return findings
+
+
+def lint_dead_rules(prog) -> list[Finding]:
+    """User partition rules that matched no leaf (see
+    `parallel.partition.dead_user_rules` — the build-time warning's
+    lint twin)."""
+    built = getattr(prog, "built", None)
+    if built is None:
+        return []
+    return [
+        Finding(
+            lint="dead-rule",
+            program=prog.name,
+            message=(
+                f"user partition rule {pattern!r} matches no parameter "
+                "leaf — the layer it meant to pin fell through to the "
+                "built-ins"
+            ),
+            detail={"pattern": pattern},
+        )
+        for pattern in getattr(built, "dead_rules", ())
+    ]
+
+
+def lint_replicated_fallthrough(prog) -> list[Finding]:
+    """Under a model-sharded (tp) rule set, a big leaf that only the
+    catch-all matched AND that stayed replicated: the rule vocabulary
+    does not know this parameter, and it silently costs full-size
+    memory on every chip."""
+    built = getattr(prog, "built", None)
+    if built is None or not built.ruleset.model_axes:
+        return []
+    from tpu_dist.parallel import partition as part
+
+    rules = built.ruleset.param_rules
+    report = part.rule_match_report(rules, built.params, built.mesh)
+    catch_all = len(rules) - 1
+    findings = []
+    for leaf in report["leaves"]:
+        if leaf["rule"] != catch_all or not leaf["replicated"]:
+            continue
+        if int(np.prod(leaf["shape"])) < BIG_LEAF_ELEMS:
+            continue
+        findings.append(
+            Finding(
+                lint="replicated-fallthrough",
+                program=prog.name,
+                message=(
+                    f"leaf {leaf['path']!r} (shape {leaf['shape']}) fell "
+                    "through to the replicated catch-all under the "
+                    f"model-sharded rule set {built.ruleset.name!r}"
+                ),
+                detail={"path": leaf["path"],
+                        "shape": list(leaf["shape"])},
+            )
+        )
+    return findings
+
+
+def lint_replicated_residency(prog) -> list[Finding]:
+    """fsdp promises sharded params+opt state, zero1 promises sharded
+    opt state — a big shardable leaf living fully replicated under
+    those rule sets defeats the memory story."""
+    built = getattr(prog, "built", None)
+    if built is None:
+        return []
+    axes = {str(k) for k in built.mesh.axis_names}
+    name = built.ruleset.name
+    targets = []
+    if "fsdp" in axes:
+        targets = [("params", built.params, built.param_specs),
+                   ("opt_state", built.opt_state, built.opt_specs)]
+        shard_axes = [a for a in ("fsdp", "dp") if a in axes]
+    elif name == "zero1" or (name or "").startswith("zero1"):
+        targets = [("opt_state", built.opt_state, built.opt_specs)]
+        shard_axes = ["dp"]
+    else:
+        return []
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dist.parallel.partition import _key_name
+
+    sizes = [int(built.mesh.shape[a]) for a in shard_axes]
+    findings = []
+    for what, tree, specs in targets:
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        spec_leaves = jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        for (kp, leaf), spec in zip(leaves, spec_leaves):
+            shape = tuple(getattr(leaf, "shape", ()))
+            elems = int(np.prod(shape)) if shape else 1
+            if elems < BIG_LEAF_ELEMS:
+                continue
+            if any(e is not None for e in tuple(spec)):
+                continue  # sharded somewhere
+            if not any(
+                d % s == 0 for d in shape for s in sizes
+            ):
+                continue  # nothing divides: replication is forced
+            path = "/".join(_key_name(k) for k in kp)
+            findings.append(
+                Finding(
+                    lint="replicated-residency",
+                    program=prog.name,
+                    message=(
+                        f"{what} leaf {path!r} (shape {shape}, {elems} "
+                        f"elems) is fully replicated under rule set "
+                        f"{name!r} — it could shard over "
+                        f"{'/'.join(shard_axes)}"
+                    ),
+                    detail={"what": what, "path": path,
+                            "shape": list(shape)},
+                )
+            )
+    return findings
+
+
+def lint_reused_keys(prog) -> list[Finding]:
+    """The same PRNG key consumed by ≥2 samplers in one traced scope."""
+    return [
+        Finding(
+            lint="reused-prng-key",
+            program=prog.name,
+            message=(
+                f"PRNG key {hit['var']} consumed {hit['uses']} times in "
+                f"scope {hit['scope']} — streams are correlated; derive "
+                "per-use keys with split/fold_in"
+            ),
+            detail=hit,
+        )
+        for hit in find_reused_keys(prog.fn, prog.args)
+    ]
+
+
+ALL_LINTS = {
+    "host-transfer": lint_host_transfer,
+    "missing-donation": lint_donation,
+    "compress-wire": lint_compress_wire,
+    "dead-rule": lint_dead_rules,
+    "replicated-fallthrough": lint_replicated_fallthrough,
+    "replicated-residency": lint_replicated_residency,
+    "reused-prng-key": lint_reused_keys,
+}
+
+
+def run_lints(prog, lints=None) -> list[Finding]:
+    """Every applicable lint over one program (a lint whose context the
+    program lacks — no rule set, no compress config — returns nothing)."""
+    out = []
+    for name in lints or ALL_LINTS:
+        out.extend(ALL_LINTS[name](prog))
+    return out

@@ -1,0 +1,413 @@
+"""The canonical entry programs the analyzer runs over.
+
+One `AnalysisProgram` per hot path the repo ships: the partition
+engine's GSPMD train step under every built-in rule set (dp / zero1 /
+fsdp / dp×fsdp / dp×tp), the legacy shard_map strategy builders the
+engine must stay plan-identical to (ROADMAP item "retire the legacy
+builders" — `plan.diff_plans` engine-vs-legacy is the pinned contract),
+the compressed-gradient step (on and off, so the s8 wire shows up as a
+plan diff), the 1F1B pipeline engine, and the serving decode/prefill
+steps.
+
+Models are deliberately tiny (a 2-layer MLP, a 2-block LM) — the
+analyzer checks PROGRAM STRUCTURE, which does not depend on width, and
+every program must compile in seconds on the CPU-sim mesh.  All
+programs build lazily and cache per process (`canonical_program`), so
+the CLI, the golden gate, and the test suite share one compile per
+program.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpu_dist.analysis import lints as lints_mod
+from tpu_dist.analysis import plan as plan_mod
+
+WORLD = 8
+PIPE_WORLD = 4
+
+# engine program -> the legacy strategy builder it must stay
+# plan-identical to (the `diff_plans` CI pin for ROADMAP's
+# legacy-builder retirement)
+PINNED_PAIRS = (
+    ("engine_dp", "legacy_dp"),
+    ("engine_zero1", "legacy_zero1"),
+    ("engine_fsdp", "legacy_fsdp"),
+)
+
+
+@dataclass
+class AnalysisProgram:
+    """One analyzable compiled program: a jitted fn + example args
+    (arrays or ShapeDtypeStructs) plus whatever context the lints can
+    use.  Lowering/compiling happens lazily and once."""
+
+    name: str
+    fn: Callable
+    args: tuple
+    mesh: Any = None
+    built: Any = None            # PartitionedTrainStep (engine programs)
+    compress: Any = None         # CompressConfig (compressed programs)
+    compress_expectations: dict | None = None
+    expect_donation: bool = False
+    donated_leaves: int | None = None
+    params: Any = None           # the param tree (leaf-count asserts)
+    tags: tuple[str, ...] = ()
+    notes: str = ""
+    _cache: dict = field(default_factory=dict, repr=False)
+
+    @property
+    def hlo_text(self) -> str:
+        if "hlo" not in self._cache:
+            self._cache["hlo"] = plan_mod.compiled_text(self.fn, self.args)
+        return self._cache["hlo"]
+
+    @property
+    def plan(self) -> plan_mod.CollectivePlan:
+        if "plan" not in self._cache:
+            self._cache["plan"] = plan_mod.extract_plan(
+                self.fn, self.args, mesh=self.mesh, name=self.name,
+                hlo_text=self.hlo_text,
+            )
+        return self._cache["plan"]
+
+    def findings(self) -> list:
+        if "findings" not in self._cache:
+            self._cache["findings"] = lints_mod.run_lints(self)
+        return self._cache["findings"]
+
+
+def _n_leaves(tree) -> int:
+    import jax
+
+    return len(jax.tree.leaves(tree))
+
+
+def _mlp_loss_pair():
+    """The shared tiny model + loss both engine and legacy programs
+    compile, so engine-vs-legacy plans are comparable."""
+    import jax
+
+    from tpu_dist import models, nn
+
+    model = nn.Sequential([
+        nn.flatten(), nn.Dense(48), nn.relu(), nn.Dense(10),
+        nn.log_softmax(),
+    ])
+    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+
+    def loss_fn(p, batch, key):
+        x, y = batch
+        scores, _ = model.apply(p, state, x, train=False)
+        return nn.nll_loss(scores, y), {}
+
+    return params, state, loss_fn, model
+
+
+def _engine(spec: str, *, name: str, user_rules=None,
+            donate: bool = True) -> AnalysisProgram:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from tpu_dist import models, parallel, train
+
+    mesh = parallel.build_mesh(spec, platform="cpu")
+    rules = parallel.resolve_rules(spec, mesh, user_rules=user_rules)
+    params, _, loss_fn, _ = _mlp_loss_pair()
+    built = parallel.make_partitioned_train_step(
+        loss_fn, train.sgd(0.05, momentum=0.5), mesh, params, rules,
+        donate=donate,
+    )
+    sh = NamedSharding(mesh, rules.batch_spec())
+    batch = (
+        jax.device_put(
+            jnp.zeros((2 * WORLD,) + models.IN_SHAPE, jnp.float32), sh
+        ),
+        jax.device_put(jnp.zeros((2 * WORLD,), jnp.int32), sh),
+    )
+    return AnalysisProgram(
+        name=name,
+        fn=built.step,
+        args=(built.params, built.opt_state, batch, jax.random.key(0)),
+        mesh=mesh,
+        built=built,
+        expect_donation=donate,
+        donated_leaves=(
+            _n_leaves(built.params) + _n_leaves(built.opt_state)
+        ) if donate else None,
+        params=params,
+        tags=("engine", "train"),
+    )
+
+
+def _engine_dp_tp() -> AnalysisProgram:
+    """dp×tp on the tiny LM — the Megatron rule vocabulary needs the
+    transformer parameter names to bind to."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from tpu_dist import parallel, train
+    from tpu_dist.models.transformer_lm import TransformerLM, lm_loss
+
+    spec = "dp=4,tp=2"
+    mesh = parallel.build_mesh(spec, platform="cpu")
+    rules = parallel.resolve_rules(spec, mesh)
+    lm = TransformerLM(vocab=64, dim=32, depth=2, heads=4, max_seq=32)
+    params, state = lm.init(jax.random.key(0))
+
+    def loss_fn(p, tokens, key):
+        logits, _ = lm.apply(p, state, tokens, train=False)
+        return lm_loss(logits.astype(jnp.float32), tokens), {}
+
+    built = parallel.make_partitioned_train_step(
+        loss_fn, train.sgd(0.05, momentum=0.5), mesh, params, rules,
+        donate=True,
+    )
+    sh = NamedSharding(mesh, rules.batch_spec())
+    tokens = jax.device_put(
+        jnp.zeros((2 * 4, 16), jnp.int32) % 64, sh
+    )
+    return AnalysisProgram(
+        name="engine_dp_tp",
+        fn=built.step,
+        args=(built.params, built.opt_state, tokens, jax.random.key(0)),
+        mesh=mesh,
+        built=built,
+        expect_donation=True,
+        donated_leaves=_n_leaves(built.params) + _n_leaves(built.opt_state),
+        params=params,
+        tags=("engine", "train", "tp"),
+    )
+
+
+def _legacy(kind: str) -> AnalysisProgram:
+    """The hand-written shard_map strategy builders, on a mesh whose
+    axis carries its ROLE name (dp for the replicated sets, fsdp for the
+    flat-row sharded set) so plans line up with the engine's axis
+    vocabulary without renames."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import comm, models, parallel, train
+
+    axis = "fsdp" if kind == "fsdp" else "dp"
+    mesh = comm.make_mesh(WORLD, (axis,), platform="cpu")
+    params, _, loss_fn, _ = _mlp_loss_pair()
+    opt = train.sgd(0.05, momentum=0.5)
+    x = jnp.zeros((2 * WORLD,) + models.IN_SHAPE, jnp.float32)
+    y = jnp.zeros((2 * WORLD,), jnp.int32)
+    sb = parallel.shard_batch((x, y), mesh, axis_name=axis)
+    if kind == "dp":
+        # the stateful builder returns the jitted (donating) step
+        # directly; `make_train_step` is its stateless wrapper and
+        # would hide the donation behind an extra closure
+        def stateful_loss(p, _s, batch, key):
+            loss, aux = loss_fn(p, batch, key)
+            return loss, ((), aux)
+
+        step = parallel.make_stateful_train_step(
+            stateful_loss, opt, mesh, axis_name=axis, donate=True
+        )
+        args = (
+            parallel.replicate(params, mesh),
+            (),
+            parallel.replicate(opt.init(params), mesh),
+            sb,
+            jax.random.key(0),
+        )
+        donated = 2 * _n_leaves(params)
+    elif kind == "fsdp":
+        step, p_sh, o_sh = parallel.make_fsdp_train_step(
+            loss_fn, opt, mesh, params, donate=True, axis_name=axis
+        )
+        args = (p_sh, o_sh, sb, jax.random.key(0))
+        donated = _n_leaves(p_sh) + _n_leaves(o_sh)
+    elif kind == "zero1":
+        step, p_z, o_z = parallel.make_zero1_train_step(
+            loss_fn, opt, mesh, params, donate=True, axis_name=axis
+        )
+        args = (p_z, o_z, sb, jax.random.key(0))
+        donated = _n_leaves(p_z) + _n_leaves(o_z)
+    else:
+        raise ValueError(f"unknown legacy kind {kind!r}")
+    return AnalysisProgram(
+        name=f"legacy_{kind}",
+        fn=step,
+        args=args,
+        mesh=mesh,
+        expect_donation=True,
+        donated_leaves=donated,
+        params=params,
+        tags=("legacy", "train"),
+    )
+
+
+def _compressed(on: bool) -> AnalysisProgram:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import comm, models, parallel, train
+    from tpu_dist.comm import compress
+
+    mesh = comm.make_mesh(WORLD, ("dp",), platform="cpu")
+    params, state, _, model = _mlp_loss_pair()
+    from tpu_dist import nn
+
+    def loss_fn(p, s, batch, key):
+        x, y = batch
+        scores, _ = model.apply(p, s, x, train=False)
+        return nn.nll_loss(scores, y), (s, {})
+
+    opt = train.sgd(0.05, momentum=0.5)
+    ccfg = (
+        compress.parse("int8,bucket_bytes=32768,block=64") if on else None
+    )
+    step = parallel.make_stateful_train_step(
+        loss_fn, opt, mesh, axis_name="dp", donate=True,
+        grad_compress=ccfg,
+    )
+    if on:
+        o = {
+            "opt": parallel.replicate(opt.init(params), mesh),
+            "ef": compress.init_ef_state(params, WORLD, ccfg, mesh, "dp"),
+        }
+    else:
+        o = parallel.replicate(opt.init(params), mesh)
+    x = jnp.zeros((2 * WORLD,) + models.IN_SHAPE, jnp.float32)
+    y = jnp.zeros((2 * WORLD,), jnp.int32)
+    args = (
+        parallel.replicate(params, mesh),
+        parallel.replicate(state, mesh),
+        o,
+        parallel.shard_batch((x, y), mesh, axis_name="dp"),
+        jax.random.key(0),
+    )
+    flat_plan = compress.FlatPlan(params, WORLD, ccfg) if on else None
+    return AnalysisProgram(
+        name="compress_int8" if on else "compress_off",
+        fn=step,
+        args=args,
+        mesh=mesh,
+        compress=ccfg,
+        compress_expectations=(
+            flat_plan.analysis_expectations() if on else None
+        ),
+        expect_donation=True,
+        params=params,
+        tags=("compress", "train"),
+    )
+
+
+def _pipeline_1f1b() -> AnalysisProgram:
+    """The schedule-driven 1F1B engine (toy uniform stages): the plan
+    must be the two neighbor ppermute rings + the gradient psum."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import comm, parallel
+
+    n, v, M, D = PIPE_WORLD, 1, 4, 8
+    mesh = comm.make_mesh(n, ("pipe",), platform="cpu")
+    sched = parallel.build_schedule(n, M, v, "1f1b")
+
+    def stage_fn(p, x):
+        return jax.nn.tanh(x @ p["w"] + p["b"])
+
+    def last_fn(pc, hp, x_in, args):
+        (t,) = args
+        return jnp.mean((stage_fn(pc, x_in) * hp["g"] - t) ** 2)
+
+    ks = jax.random.split(jax.random.key(0), n * v)
+    stages = [
+        {
+            "w": jax.random.normal(k, (D, D)) / jnp.sqrt(D),
+            "b": jax.random.normal(k, (D,)) * 0.1,
+        }
+        for k in ks
+    ]
+    nest = [[stages[c * n + s] for c in range(v)] for s in range(n)]
+    stacked = parallel.stack_chunk_params(nest)
+    hp = {"g": jnp.float32(1.3)}
+    x = jax.random.normal(jax.random.key(1), (16, D))
+    tgt = jax.random.normal(jax.random.key(2), (16, D))
+    fn = parallel.engine_program(
+        stage_fn, last_fn, sched, mesh, axis_name="pipe"
+    )
+    return AnalysisProgram(
+        name="pipeline_1f1b",
+        fn=fn,
+        args=(stacked, hp, x, (tgt,)),
+        mesh=mesh,
+        tags=("pipeline", "train"),
+    )
+
+
+def _serve(which: str) -> AnalysisProgram:
+    """The serving hot paths from a real `ServeEngine` over a tiny LM
+    (single chip: the plan should be collective-free; the lints check
+    donation, host transfers, and per-slot PRNG hygiene)."""
+    import jax
+
+    from tpu_dist.models.transformer_lm import TransformerLM
+    from tpu_dist.serve.engine import ServeConfig, ServeEngine
+
+    lm = TransformerLM(vocab=32, dim=16, depth=1, heads=2, max_seq=64)
+    params, _ = lm.init(jax.random.key(0))
+    eng = ServeEngine(
+        lm, params,
+        ServeConfig(max_batch=4, block_size=8, num_blocks=32, max_seq=64,
+                    prefill_chunk=8, prefill_batch=2),
+    )
+    fn, args = eng.analysis_programs()[which]
+    return AnalysisProgram(
+        name=which,
+        fn=fn,
+        args=args,
+        expect_donation=True,
+        tags=("serve",),
+    )
+
+
+_BUILDERS: dict[str, Callable[[], AnalysisProgram]] = {
+    "engine_dp": lambda: _engine(f"dp={WORLD}", name="engine_dp"),
+    "engine_zero1": lambda: _engine(
+        f"zero1:dp={WORLD}", name="engine_zero1"
+    ),
+    "engine_fsdp": lambda: _engine(f"fsdp={WORLD}", name="engine_fsdp"),
+    "engine_dp_fsdp": lambda: _engine(
+        "dp=2,fsdp=4", name="engine_dp_fsdp"
+    ),
+    "engine_dp_tp": _engine_dp_tp,
+    "legacy_dp": lambda: _legacy("dp"),
+    "legacy_zero1": lambda: _legacy("zero1"),
+    "legacy_fsdp": lambda: _legacy("fsdp"),
+    "compress_int8": lambda: _compressed(True),
+    "compress_off": lambda: _compressed(False),
+    "pipeline_1f1b": _pipeline_1f1b,
+    "serve_decode": lambda: _serve("serve_decode"),
+    "serve_prefill": lambda: _serve("serve_prefill"),
+}
+
+CANONICAL = tuple(_BUILDERS)
+
+_cache: dict[str, AnalysisProgram] = {}
+
+
+def canonical_program(name: str) -> AnalysisProgram:
+    """Build (once per process) one canonical program by name."""
+    if name not in _BUILDERS:
+        raise ValueError(
+            f"unknown analysis program {name!r}; one of {list(_BUILDERS)}"
+        )
+    if name not in _cache:
+        _cache[name] = _BUILDERS[name]()
+    return _cache[name]
+
+
+def canonical_programs(names=None) -> dict[str, AnalysisProgram]:
+    """The selected (default: all) canonical programs, cached."""
+    return {n: canonical_program(n) for n in (names or CANONICAL)}

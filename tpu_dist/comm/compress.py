@@ -306,6 +306,24 @@ class FlatPlan:
             "bytes_exact": self.bytes_exact(mode),
         }
 
+    def analysis_expectations(self) -> dict:
+        """What `tpu_dist.analysis` should find in a compiled step that
+        syncs through this plan: the wire itemsize every gradient-payload
+        collective must carry, and the widest operand of a WIDER dtype
+        that is still legitimate — per-bucket f32 scales ship
+        ``chunk/block`` elements per destination, and scalar loss /
+        all-finite-predicate reductions stay.  Anything wider-typed and
+        larger is a gradient payload that escaped the compressed wire
+        (the `compress-wire` lint)."""
+        return {
+            "wire": self.cfg.wire,
+            "wire_itemsize": self.cfg.wire_itemsize,
+            "n_buckets": self.n_buckets,
+            "max_wide_operand_elems": max(
+                (self.chunk // self.block) * self.n, 16
+            ),
+        }
+
     # --- error-feedback state --------------------------------------------
 
     def init_residual(self, mesh=None, axis_name: str = DEFAULT_AXIS):

@@ -96,6 +96,10 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     "request_finish": (
         "request_id", "emitted", "finish_reason", "ttft", "tpot_mean",
     ),
+    # static analyzer summary (python -m tpu_dist.analysis / make
+    # analyze): programs analyzed, findings per lint rule, golden-plan
+    # gate status ("ok" | "stale" | "missing" | "blessed" | null)
+    "analysis": ("programs", "findings", "golden"),
 }
 
 

@@ -62,6 +62,7 @@ __all__ = [
     "RuleSet",
     "PartitionedTrainStep",
     "build_mesh",
+    "dead_user_rules",
     "match_partition_rules",
     "make_partitioned_train_step",
     "make_shard_and_gather_fns",
@@ -72,6 +73,7 @@ __all__ = [
     "per_device_bytes",
     "resolve_rules",
     "resolve_trainer_rules",
+    "rule_match_report",
     "shard_over",
     "tree_paths",
 ]
@@ -215,6 +217,33 @@ def _apply_rule_value(value, path, leaf, mesh) -> P:
 # ------------------------------------------------------------ rule matching
 
 
+def _match_leaves(rules, tree: Any, mesh: Mesh) -> tuple[list, Any]:
+    """The matching core: ``([(path, shape, rule_index, spec), ...],
+    treedef)`` in leaf order.  ``rule_index`` is None for scalar/size-1
+    leaves (replicated unconditionally, no rule consulted)."""
+    rules = tuple(rules)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for kp, leaf in flat:
+        path = "/".join(_key_name(k) for k in kp)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            out.append((path, shape, None, P()))  # scalars replicate
+            continue
+        for idx, (pattern, value) in enumerate(rules):
+            if re.search(pattern, path) is not None:
+                out.append(
+                    (path, shape, idx, _apply_rule_value(value, path, leaf, mesh))
+                )
+                break
+        else:
+            raise ValueError(
+                f"no partition rule matched leaf {path!r} "
+                f"(shape {shape}); add a catch-all ('.*', P()) rule"
+            )
+    return out, treedef
+
+
 def match_partition_rules(rules, tree: Any, mesh: Mesh) -> Any:
     """`PartitionSpec` pytree for ``tree``: first rule whose regex
     ``re.search``-matches the leaf's '/'-joined path wins; scalar and
@@ -224,25 +253,66 @@ def match_partition_rules(rules, tree: Any, mesh: Mesh) -> Any:
     ``rules``: iterable of ``(pattern, value)`` where value is a
     `PartitionSpec`, a spec string (see `parse_rules`), or a callable
     ``(path, leaf, mesh) -> PartitionSpec`` (e.g. `shard_over`)."""
+    matched, treedef = _match_leaves(rules, tree, mesh)
+    return jax.tree_util.tree_unflatten(
+        treedef, [spec for _, _, _, spec in matched]
+    )
+
+
+def rule_match_report(rules, tree: Any, mesh: Mesh) -> dict:
+    """Which rule claimed which leaf — the raw material for the static
+    analyzer's dead-rule / replicated-fallthrough lints and for
+    debugging a rule set by hand.
+
+    Returns ``{"leaves": [{"path", "shape", "rule", "pattern", "spec",
+    "replicated"}, ...], "counts": [matches per rule], "dead": [indices
+    of rules that matched nothing]}``.  ``rule`` is None for the
+    scalar/size-1 leaves no rule is consulted for."""
     rules = tuple(rules)
-    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    specs = []
-    for kp, leaf in flat:
-        path = "/".join(_key_name(k) for k in kp)
-        shape = tuple(getattr(leaf, "shape", ()))
-        if len(shape) == 0 or int(np.prod(shape)) == 1:
-            specs.append(P())  # scalars (step counters, ...) replicate
-            continue
-        for pattern, value in rules:
-            if re.search(pattern, path) is not None:
-                specs.append(_apply_rule_value(value, path, leaf, mesh))
-                break
-        else:
-            raise ValueError(
-                f"no partition rule matched leaf {path!r} "
-                f"(shape {shape}); add a catch-all ('.*', P()) rule"
-            )
-    return jax.tree_util.tree_unflatten(treedef, specs)
+    matched, _ = _match_leaves(rules, tree, mesh)
+    counts = [0] * len(rules)
+    leaves = []
+    for path, shape, idx, spec in matched:
+        if idx is not None:
+            counts[idx] += 1
+        leaves.append(
+            {
+                "path": path,
+                "shape": shape,
+                "rule": idx,
+                "pattern": rules[idx][0] if idx is not None else None,
+                "spec": spec,
+                "replicated": all(e is None for e in tuple(spec)),
+            }
+        )
+    return {
+        "leaves": leaves,
+        "counts": counts,
+        "dead": [i for i, c in enumerate(counts) if c == 0],
+    }
+
+
+def dead_user_rules(
+    rules: "RuleSet", tree: Any, mesh: Mesh, *, opt_tree: Any = None
+) -> tuple[str, ...]:
+    """Patterns among the USER rules (env + config, the first
+    ``rules.n_user`` entries) that match no leaf of ``tree`` — a typo'd
+    pattern silently falling through to the built-ins is the classic way
+    a "pinned" layer ends up sharded wrong.  Dead BUILT-IN rules are
+    normal (the tp vocabulary matches nothing on a conv net) and are not
+    reported here.  User rules also apply to the optimizer state (whose
+    paths carry wrapper prefixes like ``buf/``), so pass ``opt_tree`` to
+    clear rules that legitimately pin only an opt-state leaf."""
+    if not rules.n_user:
+        return ()
+    dead = set(rule_match_report(rules.param_rules, tree, mesh)["dead"])
+    if opt_tree is not None and dead:
+        dead &= set(
+            rule_match_report(rules.opt_rules, opt_tree, mesh)["dead"]
+        )
+    return tuple(
+        rules.param_rules[i][0] for i in sorted(dead) if i < rules.n_user
+    )
 
 
 # ----------------------------------------------------------- rule parsing
@@ -311,6 +381,9 @@ class RuleSet:
     opt_rules: tuple
     data_axes: tuple[str, ...]
     model_axes: tuple[str, ...] = ()
+    # how many leading entries of param_rules/opt_rules came from the
+    # user (env + config) — the slice `dead_user_rules` audits
+    n_user: int = 0
 
     def batch_spec(self) -> P:
         """Batch partition: leading dim sharded over every data axis."""
@@ -500,6 +573,7 @@ def resolve_rules(
         opt_rules=user + tuple(opt_rules),
         data_axes=data_axes,
         model_axes=(TP_AXIS,) if has_tp else (),
+        n_user=len(user),
     )
 
 
@@ -624,6 +698,9 @@ class PartitionedTrainStep:
     opt_specs: Any
     ruleset: RuleSet
     mesh: Mesh = field(repr=False, default=None)
+    # user-rule patterns that matched no parameter leaf (surfaced as a
+    # warning event at build time and a `dead-rule` analyzer finding)
+    dead_rules: tuple[str, ...] = ()
 
     def summary(self) -> dict:
         return partition_summary(self.ruleset, self.mesh)
@@ -662,13 +739,29 @@ def make_partitioned_train_step(
     freshly placed under the rules (safe to donate immediately)."""
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    param_specs = match_partition_rules(rules.param_rules, params, mesh)
-    update_specs = match_partition_rules(rules.opt_rules, params, mesh)
     # Opt-state specs from the ABSTRACT init (eval_shape): the full
     # replicated state is never materialized — under an fsdp rule set
     # whose adamw moments only fit sharded, a concrete init here would
     # OOM before the first step.
     opt_template = jax.eval_shape(optimizer.init, params)
+    # A user rule matching ZERO leaves (in params AND opt state) is
+    # almost always a typo'd pattern whose layer silently fell through
+    # to the built-ins — loud at build time (warning + telemetry event)
+    # and a `dead-rule` lint finding in `tpu_dist.analysis`.
+    dead = dead_user_rules(rules, params, mesh, opt_tree=opt_template)
+    if dead:
+        import warnings
+
+        from tpu_dist.observe import events as _events
+
+        msg = (
+            f"partition rule set {rules.name!r}: user rules matching no "
+            f"parameter leaf (dead): {list(dead)}"
+        )
+        warnings.warn(msg, stacklevel=2)
+        _events.from_env().emit("warning", reason=msg, dead_rules=list(dead))
+    param_specs = match_partition_rules(rules.param_rules, params, mesh)
+    update_specs = match_partition_rules(rules.opt_rules, params, mesh)
     opt_specs = match_partition_rules(rules.opt_rules, opt_template, mesh)
 
     as_sharding = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
@@ -747,4 +840,5 @@ def make_partitioned_train_step(
         opt_specs=opt_specs,
         ruleset=rules,
         mesh=mesh,
+        dead_rules=dead,
     )

@@ -830,3 +830,55 @@ def pipeline_engine_loss(
 
     engine.defvjp(engine_fwd, engine_bwd)
     return engine(chunks_local, head_params, h)
+
+
+def engine_program(
+    stage_fn: Callable,
+    last_fn: Callable,
+    schedule: Schedule,
+    mesh,
+    *,
+    axis_name: str = PIPE_AXIS,
+    remat_stages: bool = False,
+):
+    """The 1F1B engine as ONE jitted SPMD program — the lowering entry
+    `tpu_dist.analysis` (and any HLO inspection) uses.
+
+    Wraps `pipeline_engine_loss` in ``shard_map`` over ``axis_name``
+    (each rank dynamic-slices its chunk params from the replicated
+    stacked pytree, exactly the executor-parity test harness) and
+    returns a jitted ``fn(stacked, head_params, h, loss_args) -> (loss,
+    (chunk_grads, head_grads))`` whose gradients are psum'd over the
+    pipe axis per the engine's gradient contract.  ``.lower(...)`` /
+    ``.trace(...)`` on the result expose the compiled collectives: the
+    fwd/bwd neighbor ppermute rings firing every tick plus the final
+    gradient psum — nothing else should appear on the wire."""
+    from jax.sharding import PartitionSpec as P
+
+    def per_rank(stacked, head_params, h, loss_args):
+        r = lax.axis_index(axis_name)
+
+        def loss(stacked, head_params):
+            chunks_local = jax.tree.map(
+                lambda t: lax.dynamic_index_in_dim(t, r, 0, keepdims=False),
+                stacked,
+            )
+            return pipeline_engine_loss(
+                stage_fn, last_fn, schedule, chunks_local, head_params,
+                h, loss_args, axis_name=axis_name,
+                remat_stages=remat_stages,
+            )
+
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1))(
+            stacked, head_params
+        )
+        return l, jax.tree.map(lambda a: lax.psum(a, axis_name), grads)
+
+    mapped = jax.shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P()),
+        out_specs=(P(), (P(), P())),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

@@ -327,6 +327,42 @@ class ServeEngine:
 
         return jax.jit(fn, donate_argnums=(1,))
 
+    # ------------------------------------------------------ static analysis
+
+    def analysis_programs(self) -> dict:
+        """The engine's hot compiled programs, exposed for
+        `tpu_dist.analysis`: ``{name: (jitted_fn, example_args)}`` with
+        `jax.ShapeDtypeStruct` arguments — lowering them compiles the
+        REAL serving step (same shapes, same donation) without touching
+        (or donating) any live buffer.
+
+        ``serve_decode`` is the steady-state sampled decode step (the
+        per-token hot path; cache + packed state donated);
+        ``serve_prefill`` is one full-width chunked-prefill round."""
+        sds = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: jax.ShapeDtypeStruct(
+                tuple(np.shape(x)), np.asarray(x).dtype
+                if not hasattr(x, "dtype") else x.dtype
+            ),
+            t,
+        )
+        params, cache = sds(self.params), sds(self.cache)
+        ints, flt = self._pack_state()
+        C, MB, Pb = (
+            self.cfg.prefill_chunk, self.blocks_per_seq,
+            self.cfg.prefill_batch,
+        )
+        p_ints = jax.ShapeDtypeStruct((Pb, C + MB + 4), np.int32)
+        p_flt = jax.ShapeDtypeStruct((Pb, 2), np.float32)
+        return {
+            "serve_decode": (
+                self._decode_fn, (params, cache, sds(ints), sds(flt))
+            ),
+            "serve_prefill": (
+                self._prefill_fn, (params, cache, p_ints, p_flt)
+            ),
+        }
+
     # ---------------------------------------------------------- front door
 
     def submit(self, prompt, max_new_tokens: int, *,
