@@ -68,8 +68,8 @@ bench-pipeline:  # 1F1B vs GPipe vs pure dp goodput at equal chips (matched dept
 	$(PY) benchmarks/lm_train.py --platform $(PLATFORM) --pipeline 1f1b
 	$(PY) benchmarks/lm_train.py --platform $(PLATFORM) --pipeline gpipe --pipe-blocks 2
 
-bench-mesh:  # partition rule sets (dp/zero1/fsdp/dp×fsdp/dp×tp) at equal chips
-	$(PY) benchmarks/mesh.py --platform $(PLATFORM) --world $(WORLD)
+bench-mesh:  # partition rule sets (dp/zero1/fsdp/dp×fsdp/dp×tp) at equal chips, exact vs int8 engine wire
+	$(PY) benchmarks/mesh.py --platform $(PLATFORM) --world $(WORLD) --compress off,int8
 
 runtime:
 	$(MAKE) -C tpu_dist/runtime

@@ -15,7 +15,9 @@ Backends:
 - ``int8``   — per-leaf quantized allreduce (`comm.all_reduce_quantized`,
   one collective per parameter tensor — the pre-bucketing toy)
 - ``bucket_int8`` / ``bucket_fp8`` / ``bucket_bf16`` — the bucketed
-  error-feedback engine (`comm.compress`, one collective per ~bucket)
+  error-feedback wire inside the partition engine's GSPMD step
+  (`make_partitioned_train_step(compress=...)`, one collective pair per
+  ~bucket)
 
 ``--bucket-sweep`` additionally sweeps the bucketed int8 backend over
 1 / 4 / 16 MB buckets.  Every run appends a structured record (with
@@ -92,24 +94,39 @@ def main():
         # ring lower bound for the uncompressed allreduce
         return int(2 * (n - 1) / n * gbytes)
 
+    # The compressed backends ride the partition engine's GSPMD step
+    # (the only compressed wire since the legacy builders retired); the
+    # engine is stateless, so its loss runs BN in inference mode — the
+    # gradient payload (what this bench times) is unchanged.
+    rules = parallel.resolve_rules(f"dp={n}", mesh, bind={"dp": "data"})
+
+    def engine_loss(p, batch, key):
+        x, y = batch
+        scores, _ = model.apply(p, state, x, train=False)
+        return nn.cross_entropy(scores, y), {}
+
     def bench_backend(name: str, *, grad_reduce="psum", grad_compress=None):
         ccfg = compress_mod.parse(grad_compress)
-        step = parallel.make_stateful_train_step(
-            loss_fn, opt, mesh, donate=False, grad_reduce=grad_reduce,
-            grad_compress=ccfg,
-        )
-        p = parallel.replicate(params, mesh)
-        s = parallel.replicate(state, mesh)
-        inner = opt.init(params)
-        if ccfg is not None and ccfg.error_feedback:
-            o = compress_mod.wrap_opt_state(
-                parallel.replicate(inner, mesh), params, n, ccfg, mesh, "data"
+        if ccfg is not None:
+            built = parallel.make_partitioned_train_step(
+                engine_loss, opt, mesh, params, rules, donate=False,
+                compress=ccfg,
             )
-            plan = compress_mod.FlatPlan(params, n, ccfg)
-            wire = plan.bytes_on_wire("all_reduce")
-            buckets = plan.n_buckets
+            p, o, s = built.params, built.opt_state, None
+
+            def step(p, s, o, batch, key):
+                p2, o2, loss, aux = built.step(p, o, batch, key)
+                return p2, s, o2, loss, aux
+
+            wire = built.flat_plan.bytes_on_wire("all_reduce")
+            buckets = built.flat_plan.n_buckets
         else:
-            o = parallel.replicate(inner, mesh)
+            step = parallel.make_spmd_train_step(
+                loss_fn, opt, mesh, donate=False, grad_reduce=grad_reduce,
+            )
+            p = parallel.replicate(params, mesh)
+            s = parallel.replicate(state, mesh)
+            o = parallel.replicate(opt.init(params), mesh)
             wire = exact_wire_bytes()
             if grad_reduce in ("int8", "fp8"):  # per-leaf 1-byte payload
                 wire = exact_wire_bytes() // 4

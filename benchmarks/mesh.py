@@ -10,7 +10,11 @@ halves for a TransformerLM + adamw on the same chip count:
   arrays' actual shards on device 0 (`partition.per_device_bytes`),
   plus XLA's compiled temp-buffer plan as the transient high water;
 - tokens/s over timed steps (data-dependent chain closed by a host
-  readback — the round-2 timing discipline).
+  readback — the round-2 timing discipline);
+- bytes-on-wire of the gradient sync per rank per step, for the exact
+  f32 wire AND the engine's compressed int8 wire (``--compress``):
+  the same rule set measured with and without the quantized EF bucket
+  collectives inside the GSPMD program.
 
 Prints a per-rule-set table to stderr and ONE JSON line to stdout;
 persists one record per rule set to ``benchmarks/results/
@@ -51,6 +55,11 @@ def build_args(argv=None):
         "'dp=8;dp=2,fsdp=4' (default: dp / zero1 / fsdp / dp×fsdp / "
         "dp×tp at --world chips)",
     )
+    ap.add_argument(
+        "--compress", default="off,int8",
+        help="comma-separated compress settings per rule set: 'off', "
+        "'int8' (the engine's quantized EF wire), or both (default)",
+    )
     ap.add_argument("--no-persist", action="store_true")
     return ap.parse_args(argv)
 
@@ -63,11 +72,12 @@ def default_rule_sets(world: int) -> list[str]:
     return sets
 
 
-def measure(args, spec: str) -> dict:
+def measure(args, spec: str, compress: str = "off") -> dict:
     import jax
     import numpy as np
 
     from tpu_dist import parallel
+    from tpu_dist.comm import compress as compress_mod
     from tpu_dist.models.transformer_lm import TransformerLM, lm_loss
     from tpu_dist.train import metrics as metrics_mod
     from tpu_dist.train.optim import adamw
@@ -85,8 +95,9 @@ def measure(args, spec: str) -> dict:
         logits, _ = lm.apply(p, {}, tokens)
         return lm_loss(logits.astype(jax.numpy.float32), tokens), {}
 
+    ccfg = compress_mod.parse(compress)
     built = parallel.make_partitioned_train_step(
-        loss_fn, adamw(1e-3), mesh, params, rules
+        loss_fn, adamw(1e-3), mesh, params, rules, compress=ccfg
     )
     from jax.sharding import NamedSharding
 
@@ -117,8 +128,36 @@ def measure(args, spec: str) -> dict:
     final = float(host_sync(loss))
     dt = time.perf_counter() - t0
     step_s = dt / max(args.steps, 1)
+    # gradient-sync bytes per rank per step: the engine plan's quantized
+    # wire when compressed, the f32 ring lower bound otherwise — BOTH
+    # over MODEL-LOCAL leaf shapes (tp-sharded grads reduce over the
+    # data axes at their shard shape in either mode), so the off-vs-int8
+    # comparison is apples-to-apples.
+    if built.flat_plan is not None:
+        wire_bytes = built.flat_plan.bytes_on_wire("all_reduce")
+    else:
+        from tpu_dist.parallel.partition import _local_shape
+
+        n_data = int(np.prod([int(mesh.shape[a]) for a in rules.data_axes]))
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        spec_leaves = treedef.flatten_up_to(built.param_specs)
+        local_tmpl = jax.tree_util.tree_unflatten(treedef, [
+            jax.ShapeDtypeStruct(
+                _local_shape(
+                    tuple(leaf.shape), spec, rules.model_axes, mesh
+                ),
+                leaf.dtype,
+            )
+            for leaf, spec in zip(p_leaves, spec_leaves)
+        ])
+        ref = compress_mod.FlatPlan(
+            local_tmpl, n_data, compress_mod.parse("int8")
+        )
+        wire_bytes = ref.bytes_exact("all_reduce")
     return {
         "rule_set": rules.name,
+        "compress": ccfg.wire if ccfg is not None else "off",
+        "grad_bytes_on_wire": int(wire_bytes),
         "mesh_axes": spec,
         "axes": {str(k): int(v) for k, v in dict(mesh.shape).items()},
         "chips": int(mesh.devices.size),
@@ -145,9 +184,18 @@ def run(args) -> dict:
             f"bench-mesh needs {args.world} devices; have "
             f"{len(jax.devices())}"
         )
-    rows = [measure(args, spec) for spec in specs]
+    modes = [m.strip() for m in args.compress.split(",") if m.strip()]
+    rows = [
+        measure(args, spec, compress=mode)
+        for spec in specs
+        for mode in modes
+    ]
     dp_bytes = next(
-        (r["state_bytes_per_chip"] for r in rows if r["rule_set"] == "dp"),
+        (
+            r["state_bytes_per_chip"]
+            for r in rows
+            if r["rule_set"] == "dp" and r["compress"] == "off"
+        ),
         None,
     )
     for r in rows:
@@ -155,7 +203,9 @@ def run(args) -> dict:
             round(r["state_bytes_per_chip"] / dp_bytes, 4) if dp_bytes else None
         )
         log(
-            f"[{r['rule_set']:>10s}] {r['tokens_per_sec']:>10,.0f} tok/s  "
+            f"[{r['rule_set']:>10s}/{r['compress']:>4s}] "
+            f"{r['tokens_per_sec']:>10,.0f} tok/s  "
+            f"wire {r['grad_bytes_on_wire'] / 1e6:6.2f} MB  "
             f"state/chip {r['state_bytes_per_chip'] / 1e6:6.2f} MB"
             + (
                 f" ({r['state_vs_dp']:.2f}x dp)"

@@ -75,7 +75,7 @@ def measure(
             scores, s2 = model.apply(p, s, x, train=True, key=key)
             return loss_metric(scores, y), (s2, {})
 
-    step = parallel.make_stateful_train_step(loss_fn, opt, mesh)
+    step = parallel.make_spmd_train_step(loss_fn, opt, mesh)
     p = parallel.replicate(params, mesh)
     ms = parallel.replicate(state, mesh)
     os_ = parallel.replicate(opt.init(params), mesh)

@@ -77,7 +77,7 @@ def main():
     from jax.sharding import PartitionSpec as P
 
     batch_spec = P("data", "model") if args.tp == "sp" else None
-    step = parallel.make_stateful_train_step(
+    step = parallel.make_spmd_train_step(
         loss_fn, opt, mesh, donate=False,
         extra_grad_axes=("model",) if args.tp else (),
         batch_spec=batch_spec,

@@ -60,7 +60,7 @@ def train_worker(rank, world):
         scores, s2 = model.apply(p, s, x, train=True, key=key)
         return nn.nll_loss(scores, y), (s2, {})
 
-    step = parallel.make_stateful_train_step(loss_fn, opt, mesh, donate=False)
+    step = parallel.make_spmd_train_step(loss_fn, opt, mesh, donate=False)
 
     def put(host, spec):
         sharding = NamedSharding(mesh, spec)
@@ -104,7 +104,7 @@ def single_process_reference(n_dev=4):
         scores, s2 = model.apply(p, s, x, train=True, key=key)
         return nn.nll_loss(scores, y), (s2, {})
 
-    step = parallel.make_stateful_train_step(loss_fn, opt, mesh, donate=False)
+    step = parallel.make_spmd_train_step(loss_fn, opt, mesh, donate=False)
     p = parallel.replicate(params, mesh)
     ms = parallel.replicate(state, mesh)
     os_ = parallel.replicate(opt.init(params), mesh)

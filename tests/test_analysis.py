@@ -1,6 +1,7 @@
 """The SPMD program analyzer (`tpu_dist.analysis`): plan extraction must
 be deterministic across retraces, the partition engine must be
-plan-identical to the legacy strategy builders (the ROADMAP
+plan-gated by blessed goldens (formerly pinned against the now-retired
+legacy strategy builders — the ROADMAP
 builder-retirement pin), every lint must fire on a seeded violation and
 stay silent on every canonical program, and the golden gate must fail
 readably when a plan changes."""
@@ -20,7 +21,6 @@ from tpu_dist.analysis import lints as L
 from tpu_dist.analysis import plan as plan_mod
 from tpu_dist.analysis.programs import (
     CANONICAL,
-    PINNED_PAIRS,
     AnalysisProgram,
     _engine,
     _mlp_loss_pair,
@@ -142,16 +142,12 @@ class TestExtraction:
 
 
 class TestDiffPlans:
-    @pytest.mark.parametrize("eng,leg", list(PINNED_PAIRS))
-    def test_engine_matches_legacy(self, eng, leg):
-        """THE acceptance pin: the partition engine's GSPMD program has
-        the same collective plan as the hand-written strategy builder
-        for dp, zero1, and fsdp — retiring the builders (ROADMAP) can
-        then be gated on this staying empty."""
-        diffs = analysis.diff_plans(
-            canonical_program(eng).plan, canonical_program(leg).plan
-        )
-        assert diffs == [], "\n".join(diffs)
+    def test_diff_of_a_plan_with_itself_is_empty(self):
+        """diff_plans' reflexivity — the contract the (now-retired)
+        engine-vs-legacy pins were built on; the builders are deleted,
+        the goldens carry the plan gate forward."""
+        a = canonical_program("engine_dp").plan
+        assert analysis.diff_plans(a, a) == []
 
     def test_different_strategies_do_differ(self):
         diffs = analysis.diff_plans(
@@ -162,8 +158,8 @@ class TestDiffPlans:
 
     def test_compress_shows_up_as_a_plan_diff(self):
         diffs = analysis.diff_plans(
-            canonical_program("compress_int8").plan,
-            canonical_program("compress_off").plan,
+            canonical_program("engine_dp_int8").plan,
+            canonical_program("engine_dp").plan,
         )
         joined = "\n".join(diffs)
         assert "s8" in joined  # the 1-byte wire is visible in the plan
@@ -242,10 +238,12 @@ class TestDonationLint:
 
 class TestCompressWireLint:
     def test_escaped_payload_fires(self):
-        """An UNcompressed step judged against compress expectations =
-        the exact signature of a payload that fell off the wire."""
-        off = canonical_program("compress_off")
-        on = canonical_program("compress_int8")
+        """An UNcompressed ENGINE step judged against the engine
+        FlatPlan's expectations = the exact signature of an engine
+        program that silently dropped to the f32 wire (the satellite's
+        true-positive requirement)."""
+        off = canonical_program("engine_dp")
+        on = canonical_program("engine_dp_int8")
         fake = AnalysisProgram(
             name="escaped", fn=off.fn, args=off.args, mesh=off.mesh,
             compress=on.compress,
@@ -255,8 +253,11 @@ class TestCompressWireLint:
         assert findings
         assert all(f.lint == "compress-wire" for f in findings)
 
-    def test_real_compressed_step_is_clean(self):
-        assert L.lint_compress_wire(canonical_program("compress_int8")) == []
+    def test_real_compressed_steps_are_clean(self):
+        assert L.lint_compress_wire(
+            canonical_program("engine_dp_int8")) == []
+        assert L.lint_compress_wire(
+            canonical_program("engine_dp_fsdp_int8")) == []
 
 
 class TestDeadRuleLint:
@@ -484,7 +485,7 @@ class TestCli:
         from tpu_dist.analysis.__main__ import main
 
         goldens = str(tmp_path / "goldens")
-        sel = "engine_dp,legacy_dp"
+        sel = "engine_dp,engine_dp_int8"
         assert main(
             ["--programs", sel, "--goldens", goldens, "--bless", "-q"]
         ) == 0
@@ -513,12 +514,12 @@ class TestCli:
         monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tmp_path))
         report = tmp_path / "report.json"
         assert main(
-            ["--programs", "engine_dp,legacy_dp", "--no-goldens",
+            ["--programs", "engine_dp,engine_dp_int8", "--no-goldens",
              "--json", str(report), "-q"]
         ) == 0
         payload = json.loads(report.read_text())
         assert "engine_dp" in payload["programs"]
-        assert payload["diffs"]["engine_dp-vs-legacy_dp"] == []
+        assert "engine_dp_int8" in payload["programs"]
         recs = [
             r for r in ev_mod.read_events(str(tmp_path))
             if r.get("event") == "analysis"

@@ -184,6 +184,7 @@ def test_mesh_bench_smoke():
         "--heads", "2", "--vocab", "64", "--seq", "32", "--batch", "16",
         "--steps", "2", "--warmup", "1",
         "--rule-sets", "dp=8;zero1:dp=8;fsdp=8;dp=2,fsdp=4",
+        "--compress", "off",
     )
     assert out["metric"] == "mesh_rule_sets"
     rows = {r["rule_set"]: r for r in out["rows"]}
@@ -198,6 +199,39 @@ def test_mesh_bench_smoke():
     # same loss (the one-step-many-rule-sets invariant)
     losses = [r["final_loss"] for r in out["rows"]]
     assert max(losses) - min(losses) < 1e-4
+
+
+def test_mesh_bench_compress_dimension():
+    """--compress off,int8: each rule set gets an exact-wire and an
+    engine-compressed row; the int8 rows ship ~4x fewer gradient bytes
+    and still land near the exact loss."""
+    out = run_bench(
+        "mesh.py", "--platform", "cpu", "--dim", "32", "--depth", "1",
+        "--heads", "2", "--vocab", "64", "--seq", "32", "--batch", "16",
+        "--steps", "2", "--warmup", "1",
+        "--rule-sets", "dp=8;dp=2,fsdp=4",
+        "--compress", "off,int8",
+    )
+    rows = {(r["rule_set"], r["compress"]): r for r in out["rows"]}
+    assert set(rows) == {
+        ("dp", "off"), ("dp", "int8"),
+        ("dp+fsdp", "off"), ("dp+fsdp", "int8"),
+    }
+    for name in ("dp", "dp+fsdp"):
+        off, on = rows[(name, "off")], rows[(name, "int8")]
+        ratio = off["grad_bytes_on_wire"] / on["grad_bytes_on_wire"]
+        assert 3.5 < ratio <= 4.0, (name, ratio)
+        assert on["tokens_per_sec"] > 0
+        assert abs(on["final_loss"] - off["final_loss"]) < 0.05
+    # persisted rows carry the compress dimension
+    results = ROOT / "benchmarks" / "results" / "bench_runs.jsonl"
+    recs = [
+        json.loads(line)
+        for line in results.read_text().splitlines()
+        if line.strip()
+    ]
+    mesh_rows = [r for r in recs if r.get("metric") == "mesh_rule_set"]
+    assert {r["compress"] for r in mesh_rows[-4:]} == {"off", "int8"}
     # persisted: the results file carries mesh rows with provenance
     results = ROOT / "benchmarks" / "results" / "bench_runs.jsonl"
     recs = [

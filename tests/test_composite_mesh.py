@@ -258,23 +258,32 @@ def test_fsdp_tp_lm_training_step_matches_dense(lm):
 
     def loss_fn(p, batch, key):
         (tok,) = batch
-        return lm.loss_tensor_parallel(p, tok, "model"), {}
+        logits, _ = lm.apply(p, {}, tok)
+        return models.lm_loss(logits, tok), {}
 
-    step, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn, train.sgd(lr), mesh, params,
-        donate=False, grad_pmean_axes=("model",),
+    # the engine's fsdp×tp rule set, bound onto this mesh's axis names
+    from tpu_dist.parallel import partition as part
+
+    rules = part.resolve_rules(
+        "fsdp=2,tp=2", mesh, bind={"fsdp": "data", "tp": "model"}
     )
-    # params 1/2 per data rank, replicated over model
-    leaf = jax.tree.leaves(p_sh)[0]
-    assert leaf.shape[0] == 2
-    assert {s.data.shape for s in leaf.addressable_shards} == {
-        (1, leaf.shape[1])
-    }
+    built = part.make_partitioned_train_step(
+        loss_fn, train.sgd(lr), mesh, params, rules, donate=False
+    )
+    p_sh, o_sh = built.params, built.opt_state
+    # at least one transformer matrix is model-sharded (Megatron rules)
+    import math
+
+    assert any(
+        leaf.addressable_shards[0].data.nbytes
+        < math.prod(leaf.shape) * leaf.dtype.itemsize
+        for leaf in jax.tree.leaves(p_sh)
+    )
     batch = (jax.device_put(tokens, NamedSharding(mesh, P("data"))),)
-    p_sh, o_sh, loss, _ = step(p_sh, o_sh, batch, jax.random.key(0))
+    p_sh, o_sh, loss, _ = built.step(p_sh, o_sh, batch, jax.random.key(0))
     assert np.isfinite(float(loss))
 
-    got = parallel.fsdp_gather_params(p_sh, params)
+    got = parallel.gather_replicated(p_sh, mesh)
     for e, g in zip(
         jax.tree.leaves(expect), jax.tree.leaves(got), strict=True
     ):
@@ -362,18 +371,27 @@ def test_fsdp_sptp_lm_training_step_matches_dense(lm):
         (tok,) = batch
         return lm.loss_tensor_parallel_sp(p, tok, "model"), {}
 
-    step, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn, train.sgd(lr), mesh, params,
-        donate=False, grad_pmean_axes=("model",),
+    # Megatron-SP layout on replicated params stays an explicit
+    # shard_map composition (no engine rule vocabulary for sequence
+    # sharding yet) — batch sharded over data AND sequence, grads
+    # pmean'd over the model axis per the TP contract.
+    step = parallel.make_spmd_train_step(
+        lambda p, s, b, k: (loss_fn(p, b, k)[0], (s, {})),
+        train.sgd(lr), mesh,
+        donate=False, extra_grad_axes=("model",),
         batch_spec=P("data", "model"),
     )
+    p_r = parallel.replicate(params, mesh)
+    o_r = parallel.replicate(train.sgd(lr).init(params), mesh)
     batch = (
         jax.device_put(tokens, NamedSharding(mesh, P("data", "model"))),
     )
-    p_sh, o_sh, loss, _ = step(p_sh, o_sh, batch, jax.random.key(0))
+    p_r, _, o_r, loss, _ = step(
+        p_r, parallel.replicate({}, mesh), o_r, batch, jax.random.key(0)
+    )
     assert np.isfinite(float(loss))
 
-    got = parallel.fsdp_gather_params(p_sh, params)
+    got = p_r
     for e, g in zip(
         jax.tree.leaves(expect), jax.tree.leaves(got), strict=True
     ):

@@ -9,6 +9,10 @@ slow-marked), residual checkpoint round-trips, the NaN-guard contract
 (a skipped step must not absorb a poisoned residual), wire-byte
 accounting, telemetry, and the HLO structure of the compiled compressed
 steps (1-byte collective operands, one collective per bucket).
+
+Since the legacy strategy builders retired, every compiled-step test
+here runs through the partition ENGINE (`make_partitioned_train_step
+(compress=...)`) — the only compressed gradient wire in the repo.
 """
 
 from __future__ import annotations
@@ -98,9 +102,19 @@ def test_all_reduce_rows_parity_vs_psum(wire):
 @pytest.mark.parametrize("wire", ("int8", "bfloat16"))
 def test_reduce_scatter_rows_parity_vs_psum_scatter(wire):
     """The compressed reduce-scatter produces each rank's exact shard
-    rows (vs `fsdp._reduce_scatter_grads`) to wire tolerance — the
-    fsdp/zero1 hop contract."""
-    from tpu_dist.parallel.fsdp import _reduce_scatter_grads
+    rows (vs a plain flat-padded ``psum_scatter``) to wire tolerance —
+    the flat-row reduce-scatter hop contract."""
+    from tpu_dist.utils.tree import pad_to_multiple
+
+    def exact_rs(grads):
+        return jax.tree.map(
+            lambda g: lax.psum_scatter(
+                pad_to_multiple(jnp.ravel(g), N).reshape(N, -1), "data",
+                scatter_dimension=0, tiled=True,
+            )
+            / N,
+            grads,
+        )
 
     cfg = compress.CompressConfig(wire=wire, bucket_bytes=4096, block=64)
     tree = _tree()
@@ -112,7 +126,7 @@ def test_reduce_scatter_rows_parity_vs_psum_scatter(wire):
             plan.to_rows(t), None, plan, "data"
         )
         shards = plan.shard_rows(local / N)
-        exact = _reduce_scatter_grads(t, N, "data")
+        exact = exact_rs(t)
         scale = jnp.max(
             jnp.stack([jnp.max(jnp.abs(e)) for e in jax.tree.leaves(exact)])
         )
@@ -248,13 +262,34 @@ def test_lm_trainer_rejects_compress_plus_model_sharding():
         )
 
 
-def test_step_builder_rejects_compress_plus_model_axes():
-    mesh = _mesh()
-    with pytest.raises(ValueError, match="data-axis"):
-        parallel.make_stateful_train_step(
-            lambda p, s, b, k: (0.0, (s, {})), train.sgd(0.1), mesh,
-            grad_compress="int8", extra_grad_axes=("model",),
+def test_compress_refusal_hint_points_at_engine_mode():
+    """After the legacy builders' retirement, compress refusals name the
+    offending axis AND point the fix at mesh_axes engine mode — not at
+    deleted builders."""
+    mesh = comm.make_mesh((4, 2), ("data", "model"), platform="cpu")
+    lm = models.TransformerLM(vocab=32, dim=16, depth=1, heads=2, max_seq=8)
+    with pytest.raises(ValueError) as ei:
+        train.LMTrainer(
+            lm, mesh,
+            train.LMTrainConfig(grad_compress="int8", tensor_parallel="psum"),
         )
+    msg = str(ei.value)
+    assert "'model'" in msg  # the offending axis, by name
+    assert "mesh_axes" in msg  # the fix: engine mode
+    assert "fsdp/zero1 strategy flags" not in msg  # no deleted-builder hints
+
+    # sequence/pipeline/moe genuinely lack support; the refusal says so
+    mesh_sp = comm.make_mesh((4, 2), ("data", "seq"), platform="cpu")
+    with pytest.raises(ValueError) as ei:
+        train.LMTrainer(
+            lm, mesh_sp,
+            train.LMTrainConfig(
+                grad_compress="int8", sequence_parallel="ring"
+            ),
+        )
+    msg = str(ei.value)
+    assert "'seq'" in msg
+    assert "rule vocabulary" in msg
 
 
 # ------------------------------------------------- wire-byte accounting
@@ -292,34 +327,35 @@ def _quad_problem():
     return x, x @ W
 
 
-def _quad_loss(params, state, batch, key):
+def _quad_loss(params, batch, key):
     x, y = batch
     pred = x @ params["w"] + params["b"]
-    return jnp.mean((pred - y) ** 2), (state, {})
+    return jnp.mean((pred - y) ** 2), {}
+
+
+def _dp_rules(mesh):
+    from tpu_dist.parallel import partition as part
+
+    return part.resolve_rules(f"dp={N}", mesh, bind={"dp": "data"})
 
 
 def _run_quad(mesh, grad_compress, steps=25, nan_batch_at=None,
               nan_guard=False):
+    """The quadratic problem through the ENGINE's dp rule set — the
+    compressed wire lives inside `make_partitioned_train_step` now."""
+    from tpu_dist.parallel import partition as part
+
     opt = train.sgd(0.1, momentum=0.5)
     if nan_guard:
         from tpu_dist.resilience.guards import nan_guard as guard
 
         opt = guard(opt, max_scale=1.0)
     params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
-    step = parallel.make_stateful_train_step(
-        _quad_loss, opt, mesh, donate=False, grad_compress=grad_compress,
+    built = part.make_partitioned_train_step(
+        _quad_loss, opt, mesh, params, _dp_rules(mesh), donate=False,
+        compress=grad_compress,
     )
-    ccfg = compress.parse(grad_compress)
-    p = parallel.replicate(params, mesh)
-    s = parallel.replicate((), mesh)
-    inner = opt.init(params)
-    if ccfg is not None and ccfg.error_feedback:
-        o = {
-            "opt": parallel.replicate(inner, mesh),
-            "ef": compress.init_ef_state(params, N, ccfg, mesh, "data"),
-        }
-    else:
-        o = parallel.replicate(inner, mesh)
+    p, o = built.params, built.opt_state
     x, y = _quad_problem()
     batch = parallel.shard_batch((x, y), mesh)
     bad_x = x.at[0, 0].set(jnp.nan)
@@ -327,7 +363,7 @@ def _run_quad(mesh, grad_compress, steps=25, nan_batch_at=None,
     losses, snapshots = [], []
     for i in range(steps):
         b = bad_batch if i == nan_batch_at else batch
-        p, s, o, loss, _ = step(p, s, o, b, jax.random.key(1))
+        p, o, loss, _ = built.step(p, o, b, jax.random.key(1))
         losses.append(float(loss))
         snapshots.append(o)
     return losses, p, o, snapshots
@@ -469,28 +505,34 @@ def test_trainer_env_var_enables_compression(monkeypatch):
     assert t2._compress is None
 
 
-def test_zero1_builder_compressed_matches_exact():
-    """Compressed ZeRO-1 training matches the exact zero1 trajectory on
-    the quadratic problem (builder-level; the mnist trainer covers dp)."""
-
-    def zero1_loss(p, batch, key):
-        x, y = batch
-        pred = x @ p["w"] + p["b"]
-        return jnp.mean((pred - y) ** 2), {}
+@pytest.mark.parametrize("spec,bind", [
+    (f"zero1:dp={N}", {"dp": "data"}),
+    (f"fsdp={N}", {"fsdp": "data"}),
+])
+def test_engine_sharded_compressed_matches_exact(spec, bind):
+    """Compressed zero1/fsdp ENGINE training matches its own exact-sync
+    trajectory on the quadratic problem — the rule sets the legacy
+    builders used to own, now on the engine wire."""
+    from tpu_dist.parallel import partition as part
 
     mesh = _mesh()
     opt = train.sgd(0.1, momentum=0.5)
-    params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
-    x, y = _quad_problem()
-    batch = parallel.shard_batch((x, y), mesh)
+    params = {"w": jnp.zeros((8, 1)), "b": jnp.zeros((1,))}
+    W = jnp.concatenate(
+        [jnp.array([[1.0], [-2.0], [0.5]]), jnp.zeros((5, 1))]
+    )
+    x = jax.random.normal(jax.random.key(0), (16, 8))
+    batch = parallel.shard_batch((x, x @ W), mesh)
+    rules = part.resolve_rules(spec, mesh, bind=bind)
 
     def run(gc):
-        step, p, o = parallel.make_zero1_train_step(
-            zero1_loss, opt, mesh, dict(params), donate=False,
-            grad_compress=gc,
+        built = part.make_partitioned_train_step(
+            _quad_loss, opt, mesh, dict(params), rules, donate=False,
+            compress=gc,
         )
+        p, o = built.params, built.opt_state
         for _ in range(20):
-            p, o, loss, _ = step(p, o, batch, jax.random.key(1))
+            p, o, loss, _ = built.step(p, o, batch, jax.random.key(1))
         return float(loss)
 
     exact, compressed = run(None), run("int8")
@@ -507,35 +549,32 @@ def _compiled_compressed_dp(ccfg):
     cached = _HLO_CACHE.get(ccfg)
     if cached is not None:  # both HLO tests probe the same compiles
         return cached
+    from tpu_dist.parallel import partition as part
+
     mesh = _mesh()
     model = models.mnist_net()
     params, state = model.init(jax.random.key(0), models.IN_SHAPE)
 
-    def loss_fn(p, s, batch, key):
+    def loss_fn(p, batch, key):
         x, y = batch
-        scores, _ = model.apply(p, s, x, train=False)
-        return nn.nll_loss(scores, y), (s, {})
+        scores, _ = model.apply(p, state, x, train=False)
+        return nn.nll_loss(scores, y), {}
 
     opt = train.sgd(0.05, momentum=0.5)
-    step = parallel.make_stateful_train_step(
-        loss_fn, opt, mesh, donate=False, grad_compress=ccfg
+    built = part.make_partitioned_train_step(
+        loss_fn, opt, mesh, params, _dp_rules(mesh), donate=False,
+        compress=ccfg,
     )
-    p = parallel.replicate(params, mesh)
-    ms = parallel.replicate(state, mesh)
-    o = {
-        "opt": parallel.replicate(opt.init(params), mesh),
-        "ef": compress.init_ef_state(params, N, ccfg, mesh, "data"),
-    }
     x = jnp.zeros((2 * N,) + models.IN_SHAPE, jnp.float32)
     y = jnp.zeros((2 * N,), jnp.int32)
     sb = parallel.shard_batch((x, y), mesh)
     txt = (
-        jax.jit(step)
-        .lower(p, ms, o, sb, jax.random.key(0))
+        built.step
+        .lower(built.params, built.opt_state, sb, jax.random.key(0))
         .compile()
         .as_text()
     )
-    result = (txt, compress.FlatPlan(params, N, ccfg))
+    result = (txt, built.flat_plan)
     _HLO_CACHE[ccfg] = result
     return result
 
@@ -593,9 +632,13 @@ def test_hlo_collective_count_scales_with_bucket_size():
     assert count(txt_big) == plan_big.n_buckets
 
 
-def test_hlo_fsdp_compressed_reduce_scatter_is_one_byte():
-    """The compressed fsdp step ships its gradient hop as s8 all-to-all
-    chunks — no f32 reduce-scatter of the gradient payload remains."""
+def test_hlo_engine_fsdp_compressed_gradient_is_one_byte():
+    """The compressed ENGINE fsdp step ships its gradient sync as s8
+    all-to-all + all-gather chunks; the only wide f32 collectives left
+    are the PARAM gathers fsdp inherently pays — no f32 gradient
+    reduce remains."""
+    from tpu_dist.parallel import partition as part
+
     mesh = _mesh()
     model = models.mnist_net()
     params, state = model.init(jax.random.key(0), models.IN_SHAPE)
@@ -607,26 +650,33 @@ def test_hlo_fsdp_compressed_reduce_scatter_is_one_byte():
 
     opt = train.sgd(0.05, momentum=0.5)
     ccfg = compress.parse("int8,bucket_bytes=65536,block=64")
-    step, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn, opt, mesh, params, donate=False, grad_compress=ccfg
+    rules = part.resolve_rules(f"fsdp={N}", mesh, bind={"fsdp": "data"})
+    built = part.make_partitioned_train_step(
+        loss_fn, opt, mesh, params, rules, donate=False, compress=ccfg
     )
     x = jnp.zeros((2 * N,) + models.IN_SHAPE, jnp.float32)
     y = jnp.zeros((2 * N,), jnp.int32)
     sb = parallel.shard_batch((x, y), mesh)
     txt = (
-        jax.jit(step).lower(p_sh, o_sh, sb, jax.random.key(0)).compile().as_text()
+        built.step
+        .lower(built.params, built.opt_state, sb, jax.random.key(0))
+        .compile()
+        .as_text()
     )
     a2a_ops = [l for l in _op_lines(txt, "all-to-all") if "s8[" in l]
-    assert a2a_ops, "no s8 all-to-all in the compressed fsdp step"
-    # the f32 gradient reduce-scatter is gone; any remaining
-    # reduce-scatter must be small (none expected on this path)
-    for line in _op_lines(txt, "reduce-scatter"):
-        for m in re.finditer(r"f32\[([\d,]*)\]", line):
-            dims = [int(d) for d in m.group(1).split(",") if d]
-            elems = int(np.prod(dims)) if dims else 1
-            assert elems <= 16, (
-                f"f32 gradient reduce-scatter survived: {line[:160]}"
-            )
+    assert a2a_ops, "no s8 all-to-all in the compressed engine fsdp step"
+    # no wide f32 gradient REDUCE survives (scales + scalar predicates
+    # only); param all-gathers are exempt — they are fsdp's own cost
+    plan = built.flat_plan
+    scale_elems = plan.chunk // plan.block
+    for op in ("all-reduce", "reduce-scatter", "all-to-all"):
+        for line in _op_lines(txt, op):
+            for m in re.finditer(r"f32\[([\d,]*)\]", line):
+                dims = [int(d) for d in m.group(1).split(",") if d]
+                elems = int(np.prod(dims)) if dims else 1
+                assert elems <= max(scale_elems * N, 16), (
+                    f"wide f32 gradient collective survived: {line[:160]}"
+                )
 
 
 # ----------------------------------------------- slow convergence parity

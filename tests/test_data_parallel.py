@@ -82,7 +82,7 @@ def test_ring_grad_reduce_matches_psum_training():
     params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
 
     def run_with(backend):
-        step = parallel.make_stateful_train_step(
+        step = parallel.make_spmd_train_step(
             stateful_loss, opt, mesh, donate=False, grad_reduce=backend
         )
         p = parallel.replicate(params, mesh)
@@ -130,7 +130,7 @@ def test_int8_grad_reduce_trains():
         loss, aux = _quadratic_loss(params, batch, key)
         return loss, (state, aux)
 
-    step = parallel.make_stateful_train_step(
+    step = parallel.make_spmd_train_step(
         stateful_loss, opt, mesh, donate=False, grad_reduce="int8"
     )
     key = jax.random.key(0)
@@ -167,7 +167,7 @@ def test_auto_step_matches_explicit_step():
         loss, aux = _quadratic_loss(params, batch, key)
         return loss, (state, aux)
 
-    explicit = parallel.make_stateful_train_step(
+    explicit = parallel.make_spmd_train_step(
         stateful_loss, opt, mesh, donate=False
     )
     auto = parallel.make_train_step_auto(
@@ -244,7 +244,7 @@ class TestGradAccumulation:
         mesh, model, params, state, opt, loss_fn, batch = self._setup()
         outs = {}
         for k in (1, 4):
-            step = parallel.make_stateful_train_step(
+            step = parallel.make_spmd_train_step(
                 loss_fn, opt, mesh, accum_steps=k, donate=False
             )
             p = parallel.replicate(params, mesh)
@@ -265,7 +265,7 @@ class TestGradAccumulation:
         from tpu_dist import parallel
 
         mesh, model, params, state, opt, loss_fn, batch = self._setup()
-        step = parallel.make_stateful_train_step(
+        step = parallel.make_spmd_train_step(
             loss_fn, opt, mesh, accum_steps=3, donate=False
         )
         p = parallel.replicate(params, mesh)
@@ -281,7 +281,7 @@ class TestGradAccumulation:
 
         mesh, model, params, state, opt, loss_fn, batch = self._setup()
         with pytest.raises(ValueError, match="accum_steps"):
-            parallel.make_stateful_train_step(
+            parallel.make_spmd_train_step(
                 loss_fn, opt, mesh, accum_steps=0
             )
 
@@ -331,7 +331,7 @@ def test_fp8_grad_reduce_trains():
         loss, aux = _quadratic_loss(params, batch, key)
         return loss, (state, aux)
 
-    step = parallel.make_stateful_train_step(
+    step = parallel.make_spmd_train_step(
         stateful_loss, opt, mesh, donate=False, grad_reduce="fp8"
     )
     key = jax.random.key(0)

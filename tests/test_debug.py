@@ -109,8 +109,11 @@ def test_assert_no_aliasing_ok_on_distinct():
 def test_trainer_rejects_buffer_sharing_optimizer():
     """An optimizer whose init returns params leaves UNCOPIED would get
     the same device buffer donated through two step arguments (jax maps
-    equal device_put inputs to one buffer); Trainer must refuse loudly
-    at construction instead of desyncing the compiled step."""
+    equal device_put inputs to one buffer); the explicit shard_map
+    path must refuse loudly at construction instead of desyncing the
+    compiled step.  The ENGINE path is immune by construction — its
+    opt state is born from a compiled init whose outputs are fresh
+    buffers — so the same optimizer simply works there."""
     import pytest
 
     from tpu_dist import comm, models, train
@@ -124,5 +127,19 @@ def test_trainer_rejects_buffer_sharing_optimizer():
     with pytest.raises(ValueError, match="alias"):
         train.Trainer(
             models.mnist_net(), models.IN_SHAPE, mesh,
-            train.TrainConfig(log=lambda s: None), optimizer=sharing,
+            # ring backend keeps the explicit shard_map step (the path
+            # that replicates host trees and can alias)
+            train.TrainConfig(log=lambda s: None, grad_reduce="ring"),
+            optimizer=sharing,
         )
+    # engine-routed dp: fresh placement, no aliasing possible
+    t = train.Trainer(
+        models.mnist_net(), models.IN_SHAPE, mesh,
+        train.TrainConfig(log=lambda s: None), optimizer=sharing,
+    )
+    assert t._ruleset is not None
+    import jax
+
+    p0 = jax.tree.leaves(t.params)[0]
+    s0 = jax.tree.leaves(t.opt_state)[0]
+    assert p0 is not s0

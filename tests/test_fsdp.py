@@ -1,9 +1,13 @@
-"""FSDP (ZeRO-3): sharded-state training must match replicated DP
-exactly, with 1/n per-rank state.
+"""FSDP/ZeRO through the partition engine: sharded-state training must
+match replicated DP exactly, with 1/n per-rank state.
 
-The optimizer update is elementwise, so updating each rank's shard with
-its shard of the mean gradient is mathematically identical to the
-replicated update — trajectories must agree to fp tolerance.
+The legacy shard_map builders are retired — the ``fsdp`` / ``zero1:dp``
+rule sets of `parallel.make_partitioned_train_step` are the one sharded
+step now (the engine-vs-builder parity held through the analyzer pins
+until deletion; these tests pin the surviving contract directly against
+replicated DP).  The flat-row layout utilities (`fsdp_shard_params` /
+`fsdp_gather_params`) remain as manual primitives and keep their own
+round-trip tests.
 """
 
 import math
@@ -14,8 +18,20 @@ import numpy as np
 import pytest
 
 from tpu_dist import comm, models, nn, parallel, train
+from tpu_dist.parallel import partition as part
 
 N = 8
+
+
+def _engine(kind, mesh, loss_fn, opt, params, **kw):
+    axis = str(mesh.axis_names[0])
+    n = int(mesh.shape[axis])
+    spec = f"fsdp={n}" if kind == "fsdp" else f"zero1:dp={n}"
+    bind = {"fsdp": axis} if kind == "fsdp" else {"dp": axis}
+    rules = part.resolve_rules(spec, mesh, bind=bind)
+    return part.make_partitioned_train_step(
+        loss_fn, opt, mesh, params, rules, donate=False, **kw
+    )
 
 
 def _setup(mesh, steps=4, batch=32):
@@ -39,7 +55,8 @@ def _setup(mesh, steps=4, batch=32):
 
 
 @pytest.mark.parametrize("opt_name", ["sgd", "adamw"])
-def test_fsdp_matches_replicated_dp(cpu_devices, opt_name):
+@pytest.mark.parametrize("kind", ["fsdp", "zero1"])
+def test_engine_sharded_matches_replicated_dp(cpu_devices, kind, opt_name):
     mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
     params, loss_fn, batches = _setup(mesh)
     opt = (
@@ -53,84 +70,77 @@ def test_fsdp_matches_replicated_dp(cpu_devices, opt_name):
     p_rep = parallel.replicate(params, mesh)
     o_rep = parallel.replicate(opt.init(params), mesh)
 
-    # FSDP trajectory
-    fsdp_step, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn, opt, mesh, params, donate=False
-    )
+    built = _engine(kind, mesh, loss_fn, opt, params)
+    p_sh, o_sh = built.params, built.opt_state
 
     for i, b in enumerate(batches):
         sb = parallel.shard_batch(b, mesh)
         key = jax.random.key(100 + i)
         p_rep, o_rep, loss_rep, _ = dp_step(p_rep, o_rep, sb, key)
-        p_sh, o_sh, loss_sh, _ = fsdp_step(p_sh, o_sh, sb, key)
+        p_sh, o_sh, loss_sh, _ = built.step(p_sh, o_sh, sb, key)
         np.testing.assert_allclose(
             float(loss_sh), float(loss_rep), rtol=1e-5,
             err_msg=f"step {i} loss diverged",
         )
 
-    gathered = parallel.fsdp_gather_params(p_sh, params)
+    gathered = parallel.gather_replicated(p_sh, mesh)
     for a, b in zip(jax.tree.leaves(gathered), jax.tree.leaves(p_rep)):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
         )
 
 
-def test_fsdp_state_is_sharded(cpu_devices):
+def test_engine_fsdp_state_is_sharded(cpu_devices):
+    """The memory contract: every big leaf of params AND opt state lives
+    1/N per device under the fsdp rule set."""
     mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
     params, loss_fn, batches = _setup(mesh, steps=1)
     opt = train.sgd(0.05, momentum=0.5)
-    step, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn, opt, mesh, params, donate=False
-    )
-    # every leaf: (N, k) sharded over the axis — each device holds 1 row
-    for leaf in jax.tree.leaves(p_sh) + jax.tree.leaves(o_sh["buf"]):
-        assert leaf.shape[0] == N
-        shard_shapes = {s.data.shape for s in leaf.addressable_shards}
-        assert shard_shapes == {(1, leaf.shape[1])}, shard_shapes
-    # per-rank bytes ≈ total/N (padding only)
-    total = sum(math.prod(l.shape) for l in jax.tree.leaves(params))
-    per_rank = sum(l.shape[1] for l in jax.tree.leaves(p_sh))
-    assert per_rank < total / N + len(jax.tree.leaves(params)) * N
-
-    # one step runs and stays sharded
+    built = _engine("fsdp", mesh, loss_fn, opt, params)
+    for leaf in jax.tree.leaves(built.params) + jax.tree.leaves(
+        built.opt_state["buf"]
+    ):
+        full = math.prod(leaf.shape) * leaf.dtype.itemsize
+        shard = leaf.addressable_shards[0].data.nbytes
+        if math.prod(leaf.shape) >= N and any(
+            d % N == 0 for d in leaf.shape
+        ):
+            assert shard * N == full, leaf.shape
+    # one step runs and stays sharded (logical shapes preserved)
     sb = parallel.shard_batch(batches[0], mesh)
-    p2, o2, loss, _ = step(p_sh, o_sh, sb, jax.random.key(0))
+    p2, o2, loss, _ = built.step(built.params, built.opt_state, sb,
+                                 jax.random.key(0))
     assert np.isfinite(float(loss))
-    assert jax.tree.leaves(p2)[0].shape[0] == N
+    for a, b in zip(jax.tree.leaves(p2), jax.tree.leaves(params)):
+        assert a.shape == b.shape
 
 
-def test_fsdp_aux_is_cross_rank_mean(cpu_devices):
-    # contract parity with make_train_step: float aux leaves come back
-    # as the cross-rank mean, not one rank's shard-local value
+def test_engine_zero1_layout(cpu_devices):
+    """Params stay replicated (full per-device shards); optimizer state
+    is sharded over dp — the ZeRO-1 memory contract."""
     mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
-    model = models.mnist_net()
-    params, state = model.init(jax.random.key(0), models.IN_SHAPE)
+    params, loss_fn, batches = _setup(mesh, steps=1)
+    opt = train.sgd(0.05, momentum=0.5)
+    built = _engine("zero1", mesh, loss_fn, opt, params)
+    for leaf, ref in zip(jax.tree.leaves(built.params),
+                         jax.tree.leaves(params)):
+        assert leaf.shape == ref.shape
+        assert leaf.addressable_shards[0].data.shape == ref.shape
+    sharded = 0
+    for leaf in jax.tree.leaves(built.opt_state["buf"]):
+        if leaf.addressable_shards[0].data.nbytes < (
+            math.prod(leaf.shape) * leaf.dtype.itemsize
+        ):
+            sharded += 1
+    # the leaves with an N-divisible dim are 1/N per device (mnist_net
+    # has exactly one at N=8: the (320, 50) dense kernel)
+    assert sharded >= 1
 
-    def loss_fn(p, batch, key):
-        x, y = batch
-        scores, _ = model.apply(p, state, x, train=False)
-        return nn.nll_loss(scores, y), {"label_sum": jnp.sum(y)}
-
-    opt = train.sgd(0.05)
-    step, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn, opt, mesh, params, donate=False
-    )
-    y = jnp.arange(2 * N, dtype=jnp.int32)  # labels 0..15 over 8 ranks
-    x = jnp.zeros((2 * N,) + models.IN_SHAPE, jnp.float32)
-    # float aux leaf -> mean of per-rank sums
-    def loss_fn_float(p, batch, key):
-        loss, aux = loss_fn(p, batch, key)
-        return loss, {"label_sum": aux["label_sum"].astype(jnp.float32)}
-
-    step_f, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn_float, opt, mesh, params, donate=False
-    )
-    sb = parallel.shard_batch((x, jnp.clip(y, 0, 9)), mesh)
-    _, _, _, aux = step_f(p_sh, o_sh, sb, jax.random.key(0))
-    per_rank_sums = np.clip(np.arange(2 * N), 0, 9).reshape(N, 2).sum(1)
-    np.testing.assert_allclose(
-        float(aux["label_sum"]), per_rank_sums.mean(), rtol=1e-6
-    )
+    sb = parallel.shard_batch(batches[0], mesh)
+    p2, o2, loss, _ = built.step(built.params, built.opt_state, sb,
+                                 jax.random.key(0))
+    assert np.isfinite(float(loss))
+    assert jax.tree.leaves(p2)[0].shape == jax.tree.leaves(params)[0].shape
 
 
 def test_fsdp_gather_roundtrip(cpu_devices):
@@ -144,12 +154,8 @@ def test_fsdp_gather_roundtrip(cpu_devices):
 
 
 def test_lm_trains_under_fsdp():
-    """The TransformerLM through the ZeRO-3 step: loss decreases and the
-    trajectory matches replicated DP to fp tolerance."""
-    import numpy as np
-
-    from tpu_dist import comm, models, parallel, train
-
+    """The TransformerLM through the engine's fsdp rule set: loss
+    decreases and the trajectory matches replicated DP to fp tolerance."""
     mesh = comm.make_mesh(4, ("data",), platform="cpu")
     lm = models.TransformerLM(vocab=64, dim=32, depth=1, heads=4, max_seq=16)
     params, _ = lm.init(jax.random.key(0))
@@ -161,13 +167,12 @@ def test_lm_trains_under_fsdp():
         logits, _ = lm.apply(p, {}, t)
         return models.lm_loss(logits, t), {}
 
-    step, sp, so = parallel.make_fsdp_train_step(
-        loss_fn, opt, mesh, params, donate=False
-    )
+    built = _engine("fsdp", mesh, loss_fn, opt, params)
     batch = parallel.shard_batch((tokens,), mesh)
+    sp, so = built.params, built.opt_state
     losses = []
     for i in range(6):
-        sp, so, loss, _ = step(sp, so, batch, jax.random.key(i))
+        sp, so, loss, _ = built.step(sp, so, batch, jax.random.key(i))
         losses.append(float(loss))
 
     # replicated-DP reference trajectory
@@ -176,7 +181,7 @@ def test_lm_trains_under_fsdp():
         logits, _ = lm.apply(p, {}, t)
         return models.lm_loss(logits, t), (s, {})
 
-    dstep = parallel.make_stateful_train_step(loss2, opt, mesh, donate=False)
+    dstep = parallel.make_spmd_train_step(loss2, opt, mesh, donate=False)
     p = parallel.replicate(params, mesh)
     ms = parallel.replicate({}, mesh)
     os_ = parallel.replicate(opt.init(params), mesh)
@@ -187,67 +192,6 @@ def test_lm_trains_under_fsdp():
 
     np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
     assert losses[-1] < losses[0]
-
-
-@pytest.mark.parametrize("opt_name", ["sgd", "adamw"])
-def test_zero1_matches_replicated_dp(cpu_devices, opt_name):
-    """ZeRO-1 (replicated params, sharded opt state): same trajectory as
-    replicated DP — the update is elementwise on row shards."""
-    mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
-    params, loss_fn, batches = _setup(mesh)
-    opt = (
-        train.sgd(0.05, momentum=0.5)
-        if opt_name == "sgd"
-        else train.adamw(1e-3, weight_decay=0.01)
-    )
-
-    dp_step = parallel.make_train_step(loss_fn, opt, mesh, donate=False)
-    p_rep = parallel.replicate(params, mesh)
-    o_rep = parallel.replicate(opt.init(params), mesh)
-
-    z_step, p_z, o_z = parallel.make_zero1_train_step(
-        loss_fn, opt, mesh, params, donate=False
-    )
-
-    for i, b in enumerate(batches):
-        sb = parallel.shard_batch(b, mesh)
-        key = jax.random.key(100 + i)
-        p_rep, o_rep, loss_rep, _ = dp_step(p_rep, o_rep, sb, key)
-        p_z, o_z, loss_z, _ = z_step(p_z, o_z, sb, key)
-        np.testing.assert_allclose(
-            float(loss_z), float(loss_rep), rtol=1e-5,
-            err_msg=f"step {i} loss diverged",
-        )
-
-    for a, b in zip(jax.tree.leaves(p_z), jax.tree.leaves(p_rep)):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
-        )
-
-
-def test_zero1_layout(cpu_devices):
-    """Params stay replicated (full shape); optimizer state is (N, k)
-    row-sharded — the ZeRO-1 memory contract."""
-    mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
-    params, loss_fn, batches = _setup(mesh, steps=1)
-    opt = train.sgd(0.05, momentum=0.5)
-    step, p_z, o_z = parallel.make_zero1_train_step(
-        loss_fn, opt, mesh, params, donate=False
-    )
-    for leaf, ref in zip(jax.tree.leaves(p_z), jax.tree.leaves(params)):
-        assert leaf.shape == ref.shape  # full logical shape, replicated
-        assert len({s.data.shape for s in leaf.addressable_shards}) == 1
-        assert leaf.addressable_shards[0].data.shape == ref.shape
-    for leaf in jax.tree.leaves(o_z["buf"]):
-        assert leaf.shape[0] == N
-        assert {s.data.shape for s in leaf.addressable_shards} == {
-            (1, leaf.shape[1])
-        }
-
-    sb = parallel.shard_batch(batches[0], mesh)
-    p2, o2, loss, _ = step(p_z, o_z, sb, jax.random.key(0))
-    assert np.isfinite(float(loss))
-    assert jax.tree.leaves(p2)[0].shape == jax.tree.leaves(params)[0].shape
 
 
 def test_gather_cache_evicts_lru_not_fifo(cpu_devices):
@@ -276,90 +220,86 @@ def test_gather_cache_evicts_lru_not_fifo(cpu_devices):
     assert hot_key in fsdp_mod._GATHER_CACHE  # survived: not FIFO
 
 
-@pytest.mark.parametrize("builder", ["fsdp", "zero1"])
-def test_clip_by_global_norm_sharded_matches_dense(cpu_devices, builder):
-    """ADVICE r4 (medium): global-norm clipping is a whole-tree
-    statistic — the sharded builders must clip by the TRUE global norm
-    (psum of squared shard norms), not each rank's shard norm.  With
-    max_norm small enough that clipping always fires, a per-shard norm
-    would scale every shard differently and the trajectory would diverge
-    from replicated DP."""
+@pytest.mark.parametrize("kind", ["fsdp", "zero1"])
+def test_clip_by_global_norm_sharded_matches_dense(cpu_devices, kind):
+    """Global-norm clipping is a whole-tree statistic — under the
+    engine's sharded rule sets the clip must use the TRUE global norm
+    (XLA reduces across shards), not a per-shard norm.  With max_norm
+    small enough that clipping always fires, a per-shard norm would
+    scale shards differently and diverge from replicated DP."""
     mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
     params, loss_fn, batches = _setup(mesh)
     opt = train.clip_by_global_norm(train.adamw(1e-3), max_norm=0.05)
-    assert not opt.elementwise  # honest: whole-tree statistic
-    assert opt.shard_update is not None
 
     dp_step = parallel.make_train_step(loss_fn, opt, mesh, donate=False)
     p_rep = parallel.replicate(params, mesh)
     o_rep = parallel.replicate(opt.init(params), mesh)
 
-    make = (
-        parallel.make_fsdp_train_step
-        if builder == "fsdp"
-        else parallel.make_zero1_train_step
-    )
-    s_step, p_s, o_s = make(loss_fn, opt, mesh, params, donate=False)
+    built = _engine(kind, mesh, loss_fn, opt, params)
+    p_s, o_s = built.params, built.opt_state
 
     for i, b in enumerate(batches):
         sb = parallel.shard_batch(b, mesh)
         key = jax.random.key(100 + i)
         p_rep, o_rep, loss_rep, _ = dp_step(p_rep, o_rep, sb, key)
-        p_s, o_s, loss_s, _ = s_step(p_s, o_s, sb, key)
+        p_s, o_s, loss_s, _ = built.step(p_s, o_s, sb, key)
         np.testing.assert_allclose(
             float(loss_s), float(loss_rep), rtol=1e-5,
             err_msg=f"step {i} loss diverged",
         )
-    if builder == "fsdp":
-        p_s = parallel.fsdp_gather_params(p_s, params)
+    p_s = parallel.gather_replicated(p_s, mesh)
     for a, b in zip(jax.tree.leaves(p_s), jax.tree.leaves(p_rep)):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
         )
 
 
-def test_non_elementwise_without_shard_update_is_refused(cpu_devices):
-    """adafactor (factored whole-tensor stats, no sharded form) and a
-    default `from_optax` wrap must be refused by the sharded builders."""
+def test_adafactor_runs_sharded_under_engine(cpu_devices):
+    """The legacy builders REFUSED non-elementwise optimizers (per-rank
+    row shards would compute whole-tensor statistics wrong).  The engine
+    lifts that: arrays are logically global — XLA inserts the cross-
+    shard reductions — so adafactor under zero1 matches replicated DP."""
     mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
-    params, loss_fn, _ = _setup(mesh, steps=1)
-    import optax
+    params, loss_fn, batches = _setup(mesh, steps=2)
+    opt = train.adafactor(1e-3)
+    assert not opt.elementwise  # whole-tensor statistics, honest flag
 
-    for opt in [train.adafactor(1e-3), train.from_optax(optax.adamw(1e-3))]:
-        assert not opt.elementwise
-        assert opt.shard_update is None
-        for make in [
-            parallel.make_fsdp_train_step,
-            parallel.make_zero1_train_step,
-        ]:
-            with pytest.raises(ValueError, match="elementwise"):
-                make(loss_fn, opt, mesh, params, donate=False)
-    # ...but an explicitly-elementwise optax chain is accepted
-    ok = train.from_optax(optax.sgd(0.05), elementwise=True)
-    parallel.make_zero1_train_step(loss_fn, ok, mesh, params, donate=False)
+    dp_step = parallel.make_train_step(loss_fn, opt, mesh, donate=False)
+    p_rep = parallel.replicate(params, mesh)
+    o_rep = parallel.replicate(opt.init(params), mesh)
+    built = _engine("zero1", mesh, loss_fn, opt, params)
+    p_z, o_z = built.params, built.opt_state
+    for i, b in enumerate(batches):
+        sb = parallel.shard_batch(b, mesh)
+        key = jax.random.key(100 + i)
+        p_rep, o_rep, loss_rep, _ = dp_step(p_rep, o_rep, sb, key)
+        p_z, o_z, loss_z, _ = built.step(p_z, o_z, sb, key)
+        np.testing.assert_allclose(float(loss_z), float(loss_rep), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(p_z), jax.tree.leaves(p_rep)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6
+        )
 
 
 def test_clip_with_ema_composition_shardable(cpu_devices):
-    """with_ema(clip(adamw)) keeps the sharded form through the wrapper
-    chain; trajectory == replicated DP."""
+    """with_ema(clip(adamw)) through the engine's zero1 rule set;
+    trajectory == replicated DP."""
     mesh = comm.make_mesh(N, ("data",), mesh_devices=cpu_devices)
     params, loss_fn, batches = _setup(mesh, steps=2)
     opt = train.with_ema(
         train.clip_by_global_norm(train.adamw(1e-3), max_norm=0.05)
     )
-    assert opt.shard_update is not None
 
     dp_step = parallel.make_train_step(loss_fn, opt, mesh, donate=False)
     p_rep = parallel.replicate(params, mesh)
     o_rep = parallel.replicate(opt.init(params), mesh)
-    z_step, p_z, o_z = parallel.make_zero1_train_step(
-        loss_fn, opt, mesh, params, donate=False
-    )
+    built = _engine("zero1", mesh, loss_fn, opt, params)
+    p_z, o_z = built.params, built.opt_state
     for i, b in enumerate(batches):
         sb = parallel.shard_batch(b, mesh)
         key = jax.random.key(100 + i)
         p_rep, o_rep, loss_rep, _ = dp_step(p_rep, o_rep, sb, key)
-        p_z, o_z, loss_z, _ = z_step(p_z, o_z, sb, key)
+        p_z, o_z, loss_z, _ = built.step(p_z, o_z, sb, key)
         np.testing.assert_allclose(float(loss_z), float(loss_rep), rtol=1e-5)
     for a, b in zip(jax.tree.leaves(p_z), jax.tree.leaves(p_rep)):
         np.testing.assert_allclose(

@@ -393,30 +393,19 @@ class TestTrainerValidation:
                 ),
             )
 
-    def test_compress_refusal_names_axes_and_rule_set(self):
-        mesh = part.build_mesh("dp=2,tp=4", platform="cpu")
-        with pytest.raises(ValueError) as ei:
-            train.LMTrainer(
+    def test_compress_now_rides_the_engine(self):
+        """ISSUE 12 lifts the old engine-mode refusals: grad_compress on
+        a pure-dp AND on a model-sharded (dp×tp) engine config builds a
+        working compressed step with the EF residual in the opt state."""
+        for spec in (f"dp={N}", "dp=2,tp=4"):
+            mesh = part.build_mesh(spec, platform="cpu")
+            t = train.LMTrainer(
                 small_lm(), mesh,
-                train.LMTrainConfig(
-                    mesh_axes="dp=2,tp=4", grad_compress="int8"
-                ),
+                train.LMTrainConfig(mesh_axes=spec, grad_compress="int8"),
             )
-        msg = str(ei.value)
-        assert "'tp'" in msg  # the offending axis, by name
-        assert "dp+tp" in msg  # the rule set that produced it
-        assert "data-axis" in msg
-
-    def test_compress_on_pure_dp_engine_says_no_wire_not_model_axes(self):
-        """A pure-dp rule set has NO model axes — the refusal must say
-        the engine lacks a compressed wire, not blame a model-sharded
-        layout that doesn't exist."""
-        mesh = part.build_mesh(f"dp={N}", platform="cpu")
-        with pytest.raises(ValueError, match="not wired into the partition"):
-            train.LMTrainer(
-                small_lm(), mesh,
-                train.LMTrainConfig(mesh_axes=f"dp={N}", grad_compress="int8"),
-            )
+            assert t._partition.compress is not None
+            assert "ef" in t.opt_state and "residual" in t.opt_state["ef"]
+            assert t._compress_summary["wire"] == "int8"
 
     def test_compress_refusal_names_mode_in_legacy_trainer(self):
         from tpu_dist import comm
@@ -552,3 +541,225 @@ class TestPartitionTelemetry:
         txt = mod.render(state, now=1.0)
         assert "mesh dp=2,fsdp=4" in txt
         assert "rules dp+fsdp" in txt
+
+
+# ------------------------------------------- engine compressed wire
+
+
+class TestEngineCompressedWire:
+    """ISSUE 12 acceptance: the int8 engine step tracks the uncompressed
+    engine step within EF tolerance on dp, dp×fsdp, and dp×tp meshes —
+    the quantized wire INSIDE the GSPMD program."""
+
+    CCFG = "int8,bucket_bytes=32768,block=64"
+
+    def _run(self, spec, compress, steps=8, lm=False):
+        mesh = part.build_mesh(spec, platform="cpu")
+        rules = part.resolve_rules(spec, mesh)
+        from jax.sharding import NamedSharding
+
+        if lm:
+            m = small_lm()
+            params, _ = m.init(jax.random.key(0))
+
+            def loss_fn(p, tokens, key):
+                from tpu_dist.models.transformer_lm import lm_loss
+
+                logits, _ = m.apply(p, {}, tokens)
+                return lm_loss(logits.astype(jnp.float32), tokens), {}
+
+            rng = np.random.default_rng(1)
+            batch = jax.device_put(
+                rng.integers(0, 64, (16, 32), dtype=np.int32),
+                NamedSharding(mesh, rules.batch_spec()),
+            )
+        else:
+            m = conv_net()
+            params, state = m.init(jax.random.key(0), models.IN_SHAPE)
+
+            def loss_fn(p, batch, key):
+                x, y = batch
+                scores, _ = m.apply(p, state, x, train=False)
+                return nn.nll_loss(scores, y), {}
+
+            batch = _mnist_batch(mesh, rules.batch_spec())
+        built = part.make_partitioned_train_step(
+            loss_fn, train.sgd(0.05, momentum=0.9), mesh, params, rules,
+            compress=compress,
+        )
+        p, o = built.params, built.opt_state
+        losses = []
+        for i in range(steps):
+            p, o, loss, _ = built.step(p, o, batch, jax.random.key(i))
+            losses.append(float(loss))
+        full = parallel.gather_replicated(p, mesh)
+        return losses, jax.tree.map(np.asarray, full), built
+
+    @pytest.mark.parametrize("spec,lm", [
+        (f"dp={N}", False),
+        ("dp=2,fsdp=4", False),
+        ("dp=2,tp=4", True),
+    ])
+    def test_int8_engine_tracks_exact_engine(self, spec, lm):
+        exact, p_e, _ = self._run(spec, None, lm=lm)
+        comp, p_c, built = self._run(spec, self.CCFG, lm=lm)
+        # EF convergence tolerance (the PR 6 bar): losses track within a
+        # few percent and the final states agree at quantization scale
+        for i, (a, b) in enumerate(zip(exact, comp)):
+            assert b == pytest.approx(a, rel=0.1, abs=5e-3), f"step {i}"
+        for (path, x), y in zip(
+            part.tree_paths(p_e), jax.tree.leaves(p_c)
+        ):
+            scale = float(np.max(np.abs(np.asarray(x)))) + 1e-8
+            assert float(np.max(np.abs(np.asarray(x) - np.asarray(y)))) \
+                < 0.12 * scale + 1e-5, path
+        # EF state present, sane, and donated through the step
+        assert built.compress is not None
+        err = float(built.opt_state["ef"]["err"])
+        assert err == 0.0  # the INITIAL state (live state was donated)
+
+    def test_tp_leaves_compress_at_shard_shape(self):
+        """dp×tp: the engine FlatPlan is built over MODEL-LOCAL shapes —
+        tp-sharded leaves enter the wire at 1/|tp| of their size."""
+        spec = "dp=2,tp=4"
+        mesh = part.build_mesh(spec, platform="cpu")
+        rules = part.resolve_rules(spec, mesh)
+        m = small_lm()
+        params, _ = m.init(jax.random.key(0))
+
+        def loss_fn(p, tokens, key):
+            from tpu_dist.models.transformer_lm import lm_loss
+
+            logits, _ = m.apply(p, {}, tokens)
+            return lm_loss(logits.astype(jnp.float32), tokens), {}
+
+        built = part.make_partitioned_train_step(
+            loss_fn, train.sgd(0.05), mesh, params, rules,
+            compress=self.CCFG,
+        )
+        import math
+
+        full_elems = sum(
+            math.prod(l.shape) for l in jax.tree.leaves(params)
+        )
+        plan_elems = sum(math.prod(s) for s in built.flat_plan.shapes)
+        assert plan_elems < full_elems  # tp-sharded leaves entered 1/|tp|
+        # residual K dim carries the model-axis product back
+        res = built.opt_state["ef"]["residual"]
+        assert res.shape == (2, 2, built.flat_plan.K_pad * 4)
+
+    def test_compressed_engine_plan_is_one_byte_on_data_axes(self):
+        """ISSUE 12 acceptance (analyzer form): the compressed engine
+        programs' plans carry s8 wire operands on the data axes and no
+        wide f32 gradient collective; dp×tp leaves tp untouched."""
+        from tpu_dist.analysis import canonical_program
+
+        for name in ("engine_dp_int8", "engine_dp_fsdp_int8"):
+            prog = canonical_program(name)
+            kinds = {(c.kind, c.dtypes[0]) for c in prog.plan}
+            assert any(dt == "s8" for _, dt in kinds), (name, kinds)
+            assert not prog.findings() or all(
+                f.severity != "error" for f in prog.findings()
+            ), prog.findings()
+
+    def test_ef_residual_checkpoints_under_dp_fsdp(self, tmp_path):
+        """Satellite: EF residual save/restore round-trips through
+        sharded directory checkpoints and latest_intact resume under
+        dp×fsdp; a residual saved under a different rule set is rejected
+        with the elastic-resume-pointing error."""
+        from tpu_dist.train import checkpoint
+        from tpu_dist.train.checkpoint import latest_intact
+
+        spec = "dp=2,fsdp=4"
+        mesh = part.build_mesh(spec, platform="cpu")
+        cfg = train.LMTrainConfig(
+            mesh_axes=spec, grad_compress="int8", epochs=1,
+            global_batch=16, inflight_steps=0, log=lambda s: None,
+        )
+        t = train.LMTrainer(small_lm(), mesh, cfg)
+        windows = np.random.default_rng(0).integers(
+            0, 64, (32, 32), dtype=np.int32
+        )
+        t.fit(windows, checkpoint_dir=str(tmp_path))
+        ck = tmp_path / "lm_ckpt_0"
+        assert ck.is_dir()
+        assert latest_intact(tmp_path) == ck
+        t2 = train.LMTrainer(small_lm(), mesh, cfg)
+        assert t2.restore(ck) == 1
+        np.testing.assert_array_equal(
+            np.asarray(t.opt_state["ef"]["residual"]),
+            np.asarray(t2.opt_state["ef"]["residual"]),
+        )
+        assert np.abs(np.asarray(t2.opt_state["ef"]["residual"])).max() > 0
+
+        # a different rule set must refuse with the elastic-resume error
+        mesh_z = part.build_mesh(f"zero1:dp={N}", platform="cpu")
+        t3 = train.LMTrainer(
+            small_lm(), mesh_z,
+            train.LMTrainConfig(
+                mesh_axes=f"zero1:dp={N}", grad_compress="int8",
+                log=lambda s: None,
+            ),
+        )
+        with pytest.raises(ValueError, match="elastic resume"):
+            t3.restore(ck)
+
+
+class TestEnginePerRankKeys:
+    """Satellite: per-rank dropout keys under the engine — the
+    compressed region folds the data-axis coordinate into the step key,
+    so per-rank random streams differ (ROADMAP item 2(b))."""
+
+    def test_per_rank_masks_differ_in_compressed_region(self):
+        """A loss whose gradient IS its dropout mask: with one shared
+        key, every data rank would draw the same mask and the mean
+        gradient would equal rank 0's mask; with per-rank folded keys it
+        equals the mean of per-rank masks.  Seeded, exact prediction."""
+        spec = "dp=4"
+        mesh = part.build_mesh(spec, platform="cpu")
+        rules = part.resolve_rules(spec, mesh)
+        params = {"w": jnp.zeros(())}
+
+        def loss_fn(p, batch, key):
+            (x,) = batch
+            # mask shaped like the LOCAL batch shard inside the region
+            mask = jax.random.bernoulli(key, 0.5, x.shape).astype(
+                jnp.float32
+            )
+            return p["w"] * jnp.mean(mask * x), {}
+
+        built = part.make_partitioned_train_step(
+            loss_fn, train.sgd(1.0), mesh, params, rules,
+            compress="bf16",  # scale-free wire: the sync is exact-ish
+        )
+        x = jnp.ones((16,), jnp.float32)
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as PS
+
+        xb = jax.device_put(x, NamedSharding(mesh, PS("dp")))
+        key = jax.random.key(123)
+        p2, _, _, _ = built.step(
+            built.params, built.opt_state, (xb,), key
+        )
+        got = -float(np.asarray(p2["w"]))  # sgd(1.0): -grad
+
+        def rank_mask(r):
+            k = jax.random.fold_in(key, r)
+            return jax.random.bernoulli(k, 0.5, (4,)).astype(jnp.float32)
+
+        per_rank = float(np.mean([np.mean(rank_mask(r)) for r in range(4)]))
+        shared = float(np.mean(rank_mask(0)))
+        assert got == pytest.approx(per_rank, abs=1e-6)
+        if abs(per_rank - shared) > 1e-9:  # seeds almost surely differ
+            assert got != pytest.approx(shared, abs=1e-9)
+
+    def test_reused_prng_key_lint_true_negative_on_engine_programs(self):
+        """The per-rank fold_in derives keys (it is not consumption) —
+        the reused-prng-key lint stays clean on the engine LM program
+        and the compressed engine programs."""
+        from tpu_dist.analysis import canonical_program
+        from tpu_dist.analysis.lints import lint_reused_keys
+
+        for name in ("engine_dp_tp", "engine_dp_int8",
+                     "engine_dp_fsdp_int8"):
+            assert lint_reused_keys(canonical_program(name)) == []

@@ -307,7 +307,7 @@ def _run_guarded(mesh, batch_ids, batches, monkeypatch, inject_step=None):
     else:
         monkeypatch.delenv(chaos.ENV_VAR, raising=False)
     opt = resilience.nan_guard(train.adamw(1e-2))
-    step = parallel.make_stateful_train_step(
+    step = parallel.make_spmd_train_step(
         _linear_loss, opt, mesh, donate=False
     )
     w = parallel.replicate({"w": np.ones(8, np.float32)}, mesh)
@@ -356,7 +356,7 @@ def test_loss_scale_is_trajectory_invariant(mesh, monkeypatch):
     batches = _linear_batches(3)
 
     def run(opt):
-        step = parallel.make_stateful_train_step(
+        step = parallel.make_spmd_train_step(
             _linear_loss, opt, mesh, donate=False
         )
         w = parallel.replicate({"w": np.ones(8, np.float32)}, mesh)

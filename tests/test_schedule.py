@@ -156,11 +156,15 @@ def test_adafactor_decay_mask_spares_biases():
     assert float(jnp.max(new["w"])) < 1.0  # decayed
 
 
-def test_adafactor_refused_by_sharded_builders():
-    """Whole-tensor statistics cannot run on per-rank shards; the
-    FSDP/ZeRO builders must refuse instead of silently diverging by
-    world size."""
+def test_adafactor_runs_under_engine_sharding():
+    """The retired flat-row builders refused whole-tensor-statistic
+    optimizers (per-rank shards would compute them wrong per world
+    size).  The partition engine computes on logically-global arrays —
+    XLA inserts the cross-shard reductions — so adafactor now runs
+    under the fsdp rule set and produces finite updates; its trajectory
+    parity vs replicated DP is pinned in test_fsdp.py."""
     from tpu_dist import comm, models, nn, parallel, train
+    from tpu_dist.parallel import partition as part
 
     mesh = comm.make_mesh(4, ("data",), platform="cpu")
     model = models.mnist_net()
@@ -170,13 +174,17 @@ def test_adafactor_refused_by_sharded_builders():
         scores, _ = model.apply(p, state, batch[0], train=False)
         return nn.nll_loss(scores, batch[1]), {}
 
-    for builder in (
-        parallel.make_fsdp_train_step,
-        parallel.make_zero1_train_step,
-    ):
-        with pytest.raises(ValueError, match="elementwise"):
-            builder(loss_fn, train.adafactor(), mesh, params)
-    # and the flag propagates through wrappers
-    wrapped = train.clip_by_global_norm(train.adafactor(), 1.0)
-    with pytest.raises(ValueError, match="elementwise"):
-        parallel.make_fsdp_train_step(loss_fn, wrapped, mesh, params)
+    rules = part.resolve_rules("fsdp=4", mesh, bind={"fsdp": "data"})
+    opt = train.clip_by_global_norm(train.adafactor(1e-3), 1.0)
+    built = part.make_partitioned_train_step(
+        loss_fn, opt, mesh, params, rules, donate=False
+    )
+    x = jnp.zeros((8,) + models.IN_SHAPE, jnp.float32)
+    y = jnp.zeros((8,), jnp.int32)
+    batch = parallel.shard_batch((x, y), mesh)
+    p, o, loss, _ = built.step(
+        built.params, built.opt_state, batch, jax.random.key(0)
+    )
+    assert np.isfinite(float(loss))
+    assert all(np.all(np.isfinite(l)) for l in jax.tree.leaves(
+        parallel.gather_replicated(p, mesh)))

@@ -147,9 +147,16 @@ def test_fsdp_state_roundtrip_resumes_identically(tmp_path, cpu_devices):
         return nn.nll_loss(scores, y), {}
 
     opt = train.sgd(0.01, momentum=0.5)
-    step, p_sh, o_sh = parallel.make_fsdp_train_step(
-        loss_fn, opt, mesh, params, donate=False
+    from tpu_dist.parallel import partition as part
+
+    axis = str(mesh.axis_names[0])
+    rules = part.resolve_rules(
+        f"fsdp={int(mesh.shape[axis])}", mesh, bind={"fsdp": axis}
     )
+    built = part.make_partitioned_train_step(
+        loss_fn, opt, mesh, params, rules, donate=False
+    )
+    step, p_sh, o_sh = built.step, built.params, built.opt_state
     rng = np.random.default_rng(0)
     batches = [
         (

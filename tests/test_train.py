@@ -393,13 +393,25 @@ def test_adamw_decay_mask_spares_biases():
 
 def test_trainer_grad_reduce_backends_train(mesh, dataset):
     """TrainConfig(grad_reduce=...) reaches the step builder: 'ring' is
-    trajectory-identical to 'psum'; 'fp8' still learns."""
+    trajectory-identical to 'psum'; 'fp8' still learns.  Dropout-free
+    model: 'psum' routes through the partition engine (one global mask
+    stream) while the non-psum backends keep the explicit shard_map
+    step (per-rank folded keys) — dropout is the one intentional
+    divergence between those paths."""
+    from tpu_dist import nn
+
+    def dropout_free():
+        return nn.Sequential([
+            nn.Conv2D(10, 5), nn.MaxPool2D(2), nn.relu(),
+            nn.flatten(), nn.Dense(50), nn.relu(),
+            nn.Dense(10), nn.log_softmax(),
+        ])
 
     def fit_with(backend):
         cfg = train.TrainConfig(
             epochs=1, log=lambda s: None, grad_reduce=backend
         )
-        t = train.Trainer(models.mnist_net(), models.IN_SHAPE, mesh, cfg)
+        t = train.Trainer(dropout_free(), models.IN_SHAPE, mesh, cfg)
         return t.fit(dataset)[-1].mean_loss
 
     psum = fit_with("psum")

@@ -49,11 +49,18 @@ def test_fsdp_trainer_state_is_sharded(mesh):
         models.mnist_net(), models.IN_SHAPE, mesh,
         train.TrainConfig(fsdp=True),
     )
-    leaf = jax.tree.leaves(t.params)[0]
-    assert leaf.shape[0] == N  # (n, k) row-sharded layout
-    assert len(leaf.sharding.device_set) == N
-    for s in leaf.addressable_shards:
-        assert s.data.shape[0] == 1  # 1/n of the leaf per device
+    assert t._ruleset is not None and t._ruleset.name == "fsdp"
+    # rule-sharded layout: leaves keep their logical shapes; any leaf
+    # with an N-divisible dim lives 1/N per device
+    import math
+
+    sharded = 0
+    for leaf in jax.tree.leaves(t.params):
+        assert len(leaf.sharding.device_set) == N
+        full = math.prod(leaf.shape) * leaf.dtype.itemsize
+        if leaf.addressable_shards[0].data.nbytes * N == full:
+            sharded += 1
+    assert sharded >= 1  # the big dense kernel shards at N=8
 
 
 def test_fsdp_trainer_checkpoint_resume(tmp_path, mesh):
@@ -133,7 +140,11 @@ def test_fsdp_restore_rejects_foreign_checkpoint(tmp_path, mesh):
         models.mnist_net(), models.IN_SHAPE, mesh,
         train.TrainConfig(fsdp=True),
     )
-    with pytest.raises(ValueError, match="structure mismatch"):
+    # the engine-routed fsdp trainer now refuses at the partition-meta
+    # gate (the alien checkpoint carries none) before the structure walk
+    with pytest.raises(
+        ValueError, match="no partition metadata|structure mismatch"
+    ):
         t.restore(tmp_path / "alien")
 
 
@@ -147,7 +158,7 @@ def test_fsdp_rejects_stateful(mesh):
 
 @pytest.mark.parametrize("builder", ["fsdp", "zero1"])
 def test_sharded_accum_matches_unaccumulated(mesh, builder):
-    """VERDICT r4 #6: accum_steps now composes with fsdp/zero1 — the
+    """accum_steps composes with the engine's fsdp/zero1 rule sets — the
     microbatch-scanned sharded step must reproduce the single-shot
     update (mean-gradient identity) to fp tolerance.  Dropout-free loss
     so the comparison is deterministic."""
@@ -155,6 +166,7 @@ def test_sharded_accum_matches_unaccumulated(mesh, builder):
     import jax.numpy as jnp
 
     from tpu_dist import nn, parallel
+    from tpu_dist.parallel import partition as part
 
     model = models.mnist_net()
     params, state = model.init(jax.random.key(0), models.IN_SHAPE)
@@ -169,19 +181,20 @@ def test_sharded_accum_matches_unaccumulated(mesh, builder):
     x = jnp.asarray(rng.normal(size=(16,) + models.IN_SHAPE), jnp.float32)
     y = jnp.asarray(rng.integers(0, 10, size=(16,)), jnp.int32)
     batch = parallel.shard_batch((x, y), mesh)
-    make = (
-        parallel.make_fsdp_train_step
-        if builder == "fsdp"
-        else parallel.make_zero1_train_step
-    )
+    spec = f"fsdp={N}" if builder == "fsdp" else f"zero1:dp={N}"
+    bind = {"fsdp": "data"} if builder == "fsdp" else {"dp": "data"}
+    rules = part.resolve_rules(spec, mesh, bind=bind)
     outs = {}
     for k in (1, 2):
-        step, p_sh, o_sh = make(
-            loss_fn, opt, mesh, params, donate=False, accum_steps=k
+        built = part.make_partitioned_train_step(
+            loss_fn, opt, mesh, params, rules, donate=False, accum_steps=k
         )
+        p_sh, o_sh = built.params, built.opt_state
         losses = []
         for i in range(3):
-            p_sh, o_sh, loss, _ = step(p_sh, o_sh, batch, jax.random.key(9))
+            p_sh, o_sh, loss, _ = built.step(
+                p_sh, o_sh, batch, jax.random.key(9)
+            )
             losses.append(float(loss))
         outs[k] = (jax.tree.map(np.asarray, p_sh), losses)
     np.testing.assert_allclose(outs[1][1], outs[2][1], rtol=2e-4, atol=1e-5)

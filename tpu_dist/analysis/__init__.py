@@ -37,7 +37,6 @@ from tpu_dist.analysis.plan import (
 )
 from tpu_dist.analysis.programs import (
     CANONICAL,
-    PINNED_PAIRS,
     AnalysisProgram,
     canonical_program,
     canonical_programs,
@@ -50,7 +49,6 @@ __all__ = [
     "Collective",
     "CollectivePlan",
     "Finding",
-    "PINNED_PAIRS",
     "canonical_program",
     "canonical_programs",
     "compare_to_golden",

@@ -1,13 +1,14 @@
 """``python -m tpu_dist.analysis`` — the SPMD program analyzer CLI.
 
 Runs collective-plan extraction + every lint over the canonical entry
-programs (`make analyze`), compares each plan to its blessed golden
+programs (`make analyze`) and compares each plan to its blessed golden
 under ``tests/goldens/`` (``--bless`` regenerates: ``make
-analyze-bless``), and diffs the partition engine's programs against the
-legacy strategy builders (the pinned engine-vs-legacy contract for
-dp/zero1/fsdp).  Exit status 1 on any lint finding, golden mismatch, or
-pinned-pair plan diff — the CI gate that turns a silent collective-
-structure regression into a readable plan diff.
+analyze-bless``).  Exit status 1 on any lint finding or golden
+mismatch — the CI gate that turns a silent collective-structure
+regression into a readable plan diff.  (The engine-vs-legacy diff pins
+retired WITH the legacy builders: they held through PR 11, every
+trainer flag now routes through the engine, and the goldens carry the
+contract forward.)
 """
 
 from __future__ import annotations
@@ -73,7 +74,7 @@ def main(argv=None) -> int:
 
     failures = 0
     findings_by_lint: dict[str, int] = {}
-    report = {"programs": {}, "diffs": {}, "golden": {}}
+    report = {"programs": {}, "golden": {}}
     for name in names:
         prog = prog_mod.canonical_program(name)
         cplan = prog.plan
@@ -122,24 +123,6 @@ def main(argv=None) -> int:
                     say(f"   GOLDEN DIFF: {d}")
                 report["golden"][name] = "stale" if diffs else "ok"
                 failures += len(diffs)
-
-    # the pinned engine-vs-legacy plan parity (ROADMAP: retire the
-    # legacy builders only while the plans stay identical)
-    for eng, leg in prog_mod.PINNED_PAIRS:
-        if eng not in names or leg not in names:
-            continue
-        diffs = plan_mod.diff_plans(
-            prog_mod.canonical_program(eng).plan,
-            prog_mod.canonical_program(leg).plan,
-        )
-        report["diffs"][f"{eng}-vs-{leg}"] = diffs
-        if diffs:
-            say(f"== PLAN DIFF {eng} vs {leg}:")
-            for d in diffs:
-                say(f"   {d}")
-            failures += len(diffs)
-        else:
-            say(f"== {eng} vs {leg}: plans identical")
 
     if args.json:
         with open(args.json, "w", encoding="utf-8") as fh:

@@ -331,8 +331,15 @@ def lint_compress_wire(prog) -> list[Finding]:
     expect = prog.compress_expectations
     max_wide = expect["max_wide_operand_elems"]
     wire_size = expect["wire_itemsize"]
+    # Engine programs under non-dp rule sets legitimately all-gather
+    # wide f32 PARAMS (fsdp entry gathers, sharded-update output
+    # gathers); a gradient payload escaping the wire shows up as a wide
+    # reduce-class or all-to-all collective either way.
+    allow_gather = bool(expect.get("allow_wide_gather"))
     findings = []
     for c in prog.plan:
+        if allow_gather and "gather" in c.kind:
+            continue
         for dt, shape in zip(c.dtypes, c.shapes):
             elems = int(np.prod(shape)) if shape else 1
             if itemsize(dt) > wire_size and elems > max_wide:

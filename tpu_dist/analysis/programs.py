@@ -2,12 +2,14 @@
 
 One `AnalysisProgram` per hot path the repo ships: the partition
 engine's GSPMD train step under every built-in rule set (dp / zero1 /
-fsdp / dp×fsdp / dp×tp), the legacy shard_map strategy builders the
-engine must stay plan-identical to (ROADMAP item "retire the legacy
-builders" — `plan.diff_plans` engine-vs-legacy is the pinned contract),
-the compressed-gradient step (on and off, so the s8 wire shows up as a
-plan diff), the 1F1B pipeline engine, and the serving decode/prefill
-steps.
+fsdp / dp×fsdp / dp×tp), the engine's COMPRESSED gradient wire
+(``engine_dp_int8`` / ``engine_dp_fsdp_int8`` — the s8 bucket
+collectives must show up in the plan, and the `compress-wire` lint
+consumes the engine FlatPlan's `analysis_expectations`), the 1F1B
+pipeline engine, and the serving decode/prefill steps.  The legacy
+shard_map strategy builders (and their engine-vs-legacy diff pins) are
+gone: the pins held through PR 11 and the builders were deleted once
+every trainer flag routed through the engine.
 
 Models are deliberately tiny (a 2-layer MLP, a 2-block LM) — the
 analyzer checks PROGRAM STRUCTURE, which does not depend on width, and
@@ -28,14 +30,9 @@ from tpu_dist.analysis import plan as plan_mod
 WORLD = 8
 PIPE_WORLD = 4
 
-# engine program -> the legacy strategy builder it must stay
-# plan-identical to (the `diff_plans` CI pin for ROADMAP's
-# legacy-builder retirement)
-PINNED_PAIRS = (
-    ("engine_dp", "legacy_dp"),
-    ("engine_zero1", "legacy_zero1"),
-    ("engine_fsdp", "legacy_fsdp"),
-)
+# small buckets/blocks so the tiny MLP still ships several buckets —
+# program STRUCTURE is what the analyzer checks, not wire volume
+COMPRESS_SPEC = "int8,bucket_bytes=32768,block=64"
 
 
 @dataclass
@@ -80,9 +77,16 @@ class AnalysisProgram:
 
 
 def _n_leaves(tree) -> int:
+    """Donation-eligible leaves: XLA reliably aliases array buffers but
+    routinely declines 0-d scalars (e.g. the engine EF 'err' scalar) —
+    counting them would turn an intact donation story into a spurious
+    partial-aliasing warning."""
     import jax
 
-    return len(jax.tree.leaves(tree))
+    return sum(
+        1 for leaf in jax.tree.leaves(tree)
+        if getattr(leaf, "ndim", 0) > 0
+    )
 
 
 def _mlp_loss_pair():
@@ -107,7 +111,7 @@ def _mlp_loss_pair():
 
 
 def _engine(spec: str, *, name: str, user_rules=None,
-            donate: bool = True) -> AnalysisProgram:
+            donate: bool = True, compress=None) -> AnalysisProgram:
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding
@@ -119,7 +123,7 @@ def _engine(spec: str, *, name: str, user_rules=None,
     params, _, loss_fn, _ = _mlp_loss_pair()
     built = parallel.make_partitioned_train_step(
         loss_fn, train.sgd(0.05, momentum=0.5), mesh, params, rules,
-        donate=donate,
+        donate=donate, compress=compress,
     )
     sh = NamedSharding(mesh, rules.batch_spec())
     batch = (
@@ -128,18 +132,29 @@ def _engine(spec: str, *, name: str, user_rules=None,
         ),
         jax.device_put(jnp.zeros((2 * WORLD,), jnp.int32), sh),
     )
+    expectations = None
+    if built.compress is not None:
+        expectations = built.flat_plan.analysis_expectations()
+        # Any rule set but plain dp legitimately all-gathers f32 PARAMS
+        # (fsdp entry gathers, sharded-update output gathers) — only
+        # reduce-class / all-to-all wide operands are gradient payloads
+        # escaping the wire there.
+        if rules.name != "dp":
+            expectations["allow_wide_gather"] = True
     return AnalysisProgram(
         name=name,
         fn=built.step,
         args=(built.params, built.opt_state, batch, jax.random.key(0)),
         mesh=mesh,
         built=built,
+        compress=built.compress,
+        compress_expectations=expectations,
         expect_donation=donate,
         donated_leaves=(
             _n_leaves(built.params) + _n_leaves(built.opt_state)
         ) if donate else None,
         params=params,
-        tags=("engine", "train"),
+        tags=("engine", "train") + (("compress",) if compress else ()),
     )
 
 
@@ -184,122 +199,6 @@ def _engine_dp_tp() -> AnalysisProgram:
     )
 
 
-def _legacy(kind: str) -> AnalysisProgram:
-    """The hand-written shard_map strategy builders, on a mesh whose
-    axis carries its ROLE name (dp for the replicated sets, fsdp for the
-    flat-row sharded set) so plans line up with the engine's axis
-    vocabulary without renames."""
-    import jax
-    import jax.numpy as jnp
-
-    from tpu_dist import comm, models, parallel, train
-
-    axis = "fsdp" if kind == "fsdp" else "dp"
-    mesh = comm.make_mesh(WORLD, (axis,), platform="cpu")
-    params, _, loss_fn, _ = _mlp_loss_pair()
-    opt = train.sgd(0.05, momentum=0.5)
-    x = jnp.zeros((2 * WORLD,) + models.IN_SHAPE, jnp.float32)
-    y = jnp.zeros((2 * WORLD,), jnp.int32)
-    sb = parallel.shard_batch((x, y), mesh, axis_name=axis)
-    if kind == "dp":
-        # the stateful builder returns the jitted (donating) step
-        # directly; `make_train_step` is its stateless wrapper and
-        # would hide the donation behind an extra closure
-        def stateful_loss(p, _s, batch, key):
-            loss, aux = loss_fn(p, batch, key)
-            return loss, ((), aux)
-
-        step = parallel.make_stateful_train_step(
-            stateful_loss, opt, mesh, axis_name=axis, donate=True
-        )
-        args = (
-            parallel.replicate(params, mesh),
-            (),
-            parallel.replicate(opt.init(params), mesh),
-            sb,
-            jax.random.key(0),
-        )
-        donated = 2 * _n_leaves(params)
-    elif kind == "fsdp":
-        step, p_sh, o_sh = parallel.make_fsdp_train_step(
-            loss_fn, opt, mesh, params, donate=True, axis_name=axis
-        )
-        args = (p_sh, o_sh, sb, jax.random.key(0))
-        donated = _n_leaves(p_sh) + _n_leaves(o_sh)
-    elif kind == "zero1":
-        step, p_z, o_z = parallel.make_zero1_train_step(
-            loss_fn, opt, mesh, params, donate=True, axis_name=axis
-        )
-        args = (p_z, o_z, sb, jax.random.key(0))
-        donated = _n_leaves(p_z) + _n_leaves(o_z)
-    else:
-        raise ValueError(f"unknown legacy kind {kind!r}")
-    return AnalysisProgram(
-        name=f"legacy_{kind}",
-        fn=step,
-        args=args,
-        mesh=mesh,
-        expect_donation=True,
-        donated_leaves=donated,
-        params=params,
-        tags=("legacy", "train"),
-    )
-
-
-def _compressed(on: bool) -> AnalysisProgram:
-    import jax
-    import jax.numpy as jnp
-
-    from tpu_dist import comm, models, parallel, train
-    from tpu_dist.comm import compress
-
-    mesh = comm.make_mesh(WORLD, ("dp",), platform="cpu")
-    params, state, _, model = _mlp_loss_pair()
-    from tpu_dist import nn
-
-    def loss_fn(p, s, batch, key):
-        x, y = batch
-        scores, _ = model.apply(p, s, x, train=False)
-        return nn.nll_loss(scores, y), (s, {})
-
-    opt = train.sgd(0.05, momentum=0.5)
-    ccfg = (
-        compress.parse("int8,bucket_bytes=32768,block=64") if on else None
-    )
-    step = parallel.make_stateful_train_step(
-        loss_fn, opt, mesh, axis_name="dp", donate=True,
-        grad_compress=ccfg,
-    )
-    if on:
-        o = {
-            "opt": parallel.replicate(opt.init(params), mesh),
-            "ef": compress.init_ef_state(params, WORLD, ccfg, mesh, "dp"),
-        }
-    else:
-        o = parallel.replicate(opt.init(params), mesh)
-    x = jnp.zeros((2 * WORLD,) + models.IN_SHAPE, jnp.float32)
-    y = jnp.zeros((2 * WORLD,), jnp.int32)
-    args = (
-        parallel.replicate(params, mesh),
-        parallel.replicate(state, mesh),
-        o,
-        parallel.shard_batch((x, y), mesh, axis_name="dp"),
-        jax.random.key(0),
-    )
-    flat_plan = compress.FlatPlan(params, WORLD, ccfg) if on else None
-    return AnalysisProgram(
-        name="compress_int8" if on else "compress_off",
-        fn=step,
-        args=args,
-        mesh=mesh,
-        compress=ccfg,
-        compress_expectations=(
-            flat_plan.analysis_expectations() if on else None
-        ),
-        expect_donation=True,
-        params=params,
-        tags=("compress", "train"),
-    )
 
 
 def _pipeline_1f1b() -> AnalysisProgram:
@@ -382,11 +281,12 @@ _BUILDERS: dict[str, Callable[[], AnalysisProgram]] = {
         "dp=2,fsdp=4", name="engine_dp_fsdp"
     ),
     "engine_dp_tp": _engine_dp_tp,
-    "legacy_dp": lambda: _legacy("dp"),
-    "legacy_zero1": lambda: _legacy("zero1"),
-    "legacy_fsdp": lambda: _legacy("fsdp"),
-    "compress_int8": lambda: _compressed(True),
-    "compress_off": lambda: _compressed(False),
+    "engine_dp_int8": lambda: _engine(
+        f"dp={WORLD}", name="engine_dp_int8", compress=COMPRESS_SPEC
+    ),
+    "engine_dp_fsdp_int8": lambda: _engine(
+        "dp=2,fsdp=4", name="engine_dp_fsdp_int8", compress=COMPRESS_SPEC
+    ),
     "pipeline_1f1b": _pipeline_1f1b,
     "serve_decode": lambda: _serve("serve_decode"),
     "serve_prefill": lambda: _serve("serve_prefill"),

@@ -24,10 +24,17 @@ chunks.  That single layout serves BOTH wire patterns:
   chunk — the bandwidth-optimal allreduce with 1-byte lanes.  Used by
   the replicated-DP step.
 - ``reduce_scatter_rows``: the first half only — each rank ends with its
-  f32-reduced row, which `FlatPlan.shard_rows` slices back into the
-  per-leaf ``(1, k)`` rows the fsdp/zero1 optimizer update consumes.
-  Half the wire cost of the allreduce, exactly like the uncompressed
-  ``psum_scatter`` hop it replaces.
+  f32-reduced row, which `FlatPlan.shard_rows` slices back into
+  per-leaf ``(1, k)`` rows.  Half the wire cost of the allreduce; kept
+  as a manual-sharding primitive (the retired fsdp/zero1 builders'
+  gradient hop).
+
+The production consumer is the PARTITION ENGINE:
+`parallel.make_partitioned_train_step(compress=...)` runs
+`all_reduce_rows` over the rule set's composed data axes inside its
+GSPMD program (model-sharded leaves at their shard shape via a nested
+shard_map over the model axes), with the EF residual as engine opt
+state (`init_engine_ef_state` / `engine_residual_spec`).
 
 Error feedback covers BOTH quantization rounds of the allreduce: the
 local error ``acc - dequant(quant(acc))`` is fed back everywhere, and
@@ -369,11 +376,11 @@ def init_ef_state(template: Any, n: int, cfg: CompressConfig, mesh=None,
 
 def wrap_opt_state(inner, template: Any, n: int, cfg: CompressConfig,
                    mesh=None, axis_name: str = DEFAULT_AXIS) -> dict:
-    """The ``{"opt", "ef"}`` opt-state wrapper the compressed step
-    builders expect — ONE constructor for every caller (trainers,
-    benches), so the wrapper schema cannot drift from `ef_specs` /
-    the builders' expectations.  ``inner`` is the (already placed)
-    optimizer state; ``template`` supplies the gradient shapes."""
+    """The ``{"opt", "ef"}`` opt-state wrapper around a single-axis EF
+    state — ONE constructor for manual shard_map harnesses and tests
+    (the ENGINE builds its own wrapper via `init_engine_ef_state`).
+    ``inner`` is the (already placed) optimizer state; ``template``
+    supplies the gradient shapes."""
     return {
         "opt": inner,
         "ef": init_ef_state(template, n, cfg, mesh, axis_name),
@@ -420,11 +427,50 @@ def ef_error(opt_state) -> float | None:
     return None
 
 
-def ef_specs(axis_name: str = DEFAULT_AXIS):
-    """shard_map spec prefix for an `init_ef_state` tree."""
+def engine_residual_spec(data_axes, model_axes=()):
+    """PartitionSpec of the ENGINE's EF residual: globally ``(n_data,
+    n_data, K_pad · n_model)`` with dim 0 sharded over the composed data
+    axes (rank r's block is ITS local error) and the K dim sharded over
+    the model axes (each model shard carries the residual of ITS slice
+    of every gradient leaf — the wire compresses tp-sharded grads at
+    their shard shape)."""
     from jax.sharding import PartitionSpec as P
 
-    return {"residual": P(axis_name), "err": P()}
+    d = tuple(data_axes)
+    m = tuple(model_axes)
+    return P(
+        d if len(d) > 1 else d[0],
+        None,
+        (m if len(m) > 1 else m[0]) if m else None,
+    )
+
+
+def init_engine_ef_state(
+    plan: "FlatPlan", mesh, data_axes, model_axes=()
+) -> dict:
+    """The engine's error-feedback state (`make_partitioned_train_step
+    (compress=...)`): ``{"residual", "err"}`` with the residual born
+    sharded per `engine_residual_spec` — ``plan`` is the engine's
+    FlatPlan over MODEL-LOCAL leaf shapes, so its ``K_pad`` is the
+    per-model-shard row length and the global K dim is ``K_pad`` times
+    the model-axis size."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    model_k = (
+        int(np.prod([int(mesh.shape[a]) for a in model_axes]))
+        if model_axes
+        else 1
+    )
+    shape = (plan.n, plan.n, plan.K_pad * model_k)
+    sharding = NamedSharding(mesh, engine_residual_spec(data_axes, model_axes))
+    residual = jax.jit(
+        lambda: jnp.zeros(shape, jnp.float32), out_shardings=sharding
+    )()
+    err = jax.device_put(
+        jnp.zeros((), jnp.float32), NamedSharding(mesh, P())
+    )
+    return {"residual": residual, "err": err}
 
 
 # ---------------------------------------------------------------------------
@@ -474,8 +520,14 @@ def all_reduce_rows(
     residual: jax.Array | None,
     plan: FlatPlan,
     axis_name: str = DEFAULT_AXIS,
+    *,
+    predicate_axes=None,
 ):
     """Bucketed quantized all-reduce of an ``(n, K_pad)`` row matrix.
+
+    ``axis_name`` may be one mesh axis or a TUPLE of axes (the engine
+    reduces over composed data axes, e.g. ``('dp', 'fsdp')``) — every
+    collective inside treats the tuple as one flattened axis.
 
     Returns ``(sum_rows, new_residual, stats)`` — ``sum_rows`` is the
     cross-rank SUM (callers divide by n for the mean), ``new_residual``
@@ -484,10 +536,16 @@ def all_reduce_rows(
     globally non-finite input the output rows are NaN (so a NaN guard
     trips exactly as under exact sync) and the residual is held
     unchanged — a skipped step must not absorb a poisoned residual.
+    ``predicate_axes`` widens the all-finite reduction (default: the
+    reduction axes) — the engine passes data+model axes so a NaN on one
+    model shard poisons the WHOLE step, not one tp slice of it.
     """
     cfg = plan.cfg
     acc = rows + residual if residual is not None else rows
-    ok = lax.psum(_nonfinite_count(acc), axis_name) == 0
+    ok = lax.psum(
+        _nonfinite_count(acc),
+        predicate_axes if predicate_axes is not None else axis_name,
+    ) == 0
     q, scales = _quant_blocks(acc, cfg)
     deq = _dequant_blocks(q, scales, cfg)
     err1 = acc - deq  # this rank's first-round quantization error

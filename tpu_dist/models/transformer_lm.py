@@ -731,7 +731,7 @@ class TransformerLM(Module):
         schedule_kind: str | None = None,
     ):
         """Pipeline-parallel TRAINING loss for use INSIDE shard_map over
-        a ``pipe`` axis (`parallel.make_stateful_train_step` with
+        a ``pipe`` axis (`parallel.make_spmd_train_step` with
         ``grad_psum_axes=(axis_name,)``).
 
         ``engine=False`` (the GPipe-era path): forward-only scheduling

@@ -58,8 +58,8 @@ def average_gradients(
     gradient-noise-level error; fp8 = e4m3 wire, relative precision for
     heavy-tailed gradients; bf16 = scale-free cast).  ``'psum'`` (XLA
     AllReduce) is the production default; for the bucketed
-    error-feedback engine see ``grad_compress`` on
-    `make_stateful_train_step` (`comm.compress`).
+    error-feedback engine see ``compress`` on
+    `partition.make_partitioned_train_step` (`comm.compress`).
     """
     if backend == "psum":
         return lax.pmean(grads, axis_name)
@@ -106,14 +106,14 @@ def make_train_step(
     program, so XLA overlaps it with the backward pass (the fused design
     required for the 8-chip scaling target, SURVEY.md §7 hard part (e)).
 
-    Implemented as the stateless special case of `make_stateful_train_step`.
+    Implemented as the stateless special case of `make_spmd_train_step`.
     """
 
     def stateful_loss(params, _state, batch, key):
         loss, aux = loss_fn(params, batch, key)
         return loss, ((), aux)
 
-    stateful = make_stateful_train_step(
+    stateful = make_spmd_train_step(
         stateful_loss, optimizer, mesh, axis_name=axis_name, donate=donate
     )
 
@@ -187,7 +187,7 @@ def accumulate_microbatches(
     return grads, lsum / accum_steps, new_state, aux
 
 
-def make_stateful_train_step(
+def make_spmd_train_step(
     loss_fn: Callable[..., Any],
     optimizer,
     mesh: Mesh,
@@ -199,7 +199,6 @@ def make_stateful_train_step(
     extra_grad_axes: tuple[str, ...] = (),
     grad_psum_axes: tuple[str, ...] = (),
     batch_spec=None,
-    grad_compress=None,
 ):
     """Like `make_train_step` but threads non-differentiated model state
     (e.g. batch-norm running statistics) through the step.
@@ -234,37 +233,12 @@ def make_stateful_train_step(
     e.g. BN statistics see smaller batches — are inherent to
     accumulation).  Aux float leaves are averaged over microbatches.
 
-    ``grad_compress`` (a `comm.compress.CompressConfig` or spec string,
-    e.g. ``"int8"``) replaces the gradient reduce with the bucketed
-    quantized allreduce + error-feedback engine (`comm.compress`).  The
-    step's ``opt_state`` argument/output then becomes the wrapper
-    ``{"opt": <optimizer state>, "ef": compress.init_ef_state(...)}``
-    carrying the per-rank residual (checkpoint the wrapper and the
-    residual rides along).  Data-axis reduction only — incompatible with
-    ``extra_grad_axes`` / ``grad_psum_axes`` and with a non-psum
-    ``grad_reduce``.
+    For the bucketed error-feedback compressed gradient wire, use the
+    partition engine: `partition.make_partitioned_train_step`'s
+    ``compress`` option carries it inside the GSPMD program.
     """
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    from tpu_dist.comm import compress as compress_mod
-
-    ccfg = compress_mod.parse(grad_compress)
-    if ccfg is not None:
-        if grad_reduce != "psum":
-            raise ValueError(
-                f"grad_compress replaces the gradient reduce — use it with "
-                f"grad_reduce='psum', not {grad_reduce!r}"
-            )
-        if extra_grad_axes or grad_psum_axes:
-            compress_mod.refuse_model_axes(
-                "make_stateful_train_step",
-                tuple(extra_grad_axes) + tuple(grad_psum_axes),
-                rules="extra_grad_axes/grad_psum_axes (the TP/pipeline "
-                "gradient contracts)",
-            )
-    # EF threads a residual through the opt-state slot; without EF the
-    # compressed reduce is stateless and the contract is unchanged.
-    wrap_ef = ccfg is not None and ccfg.error_feedback
 
     # A `resilience.nan_guard`-wrapped optimizer advertises its live
     # dynamic loss scale; the builder threads it through the backward
@@ -298,8 +272,7 @@ def make_stateful_train_step(
         # fold over the DATA axis only: model-axis ranks run the same
         # replicated computation and must share keys (dropout identity)
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        inner_opt = opt_state["opt"] if wrap_ef else opt_state
-        scale = scale_fn(inner_opt) if scale_fn is not None else None
+        scale = scale_fn(opt_state) if scale_fn is not None else None
         gm = functools.partial(grads_and_metrics, scale=scale)
         if accum_steps == 1:
             grads, loss, new_state, aux = gm(params, model_state, batch, key)
@@ -312,25 +285,9 @@ def make_stateful_train_step(
             # the corner where every gradient stays finite (e.g. the NaN
             # arises in a branch with zero cotangent) — poison the grads
             # BEFORE the reduce, so the exact psum propagates the NaN to
-            # every rank and the compressed path's all-finite predicate
-            # holds the error-feedback residual (a step the guard skips
-            # must not absorb it).
+            # every rank and the guard skips the step.
             grads = _poison(grads, ~jnp.isfinite(loss))
-        new_ef = None
-        if ccfg is None:
-            grads = average_gradients(grads, axis_name, backend=grad_reduce)
-        else:
-            # Bucketed quantized allreduce with error feedback: the
-            # residual rides the opt-state wrapper (per-rank state).
-            n_data = lax.axis_size(axis_name)
-            plan = compress_mod.FlatPlan(grads, n_data, ccfg)
-            res = opt_state["ef"]["residual"][0] if wrap_ef else None
-            total, new_res, stats = compress_mod.all_reduce_rows(
-                plan.to_rows(grads), res, plan, axis_name
-            )
-            grads = plan.from_rows(total / n_data)
-            if wrap_ef:
-                new_ef = {"residual": new_res[None], "err": stats["err"]}
+        grads = average_gradients(grads, axis_name, backend=grad_reduce)
         loss = lax.pmean(loss, axis_name)
         for ax in extra_grad_axes:
             grads = jax.tree.map(lambda g: lax.pmean(g, ax), grads)
@@ -344,25 +301,18 @@ def make_stateful_train_step(
             aux = _pmean_float_leaves(aux, ax)
         new_state = _pmean_float_leaves(new_state, axis_name)
         aux = _pmean_float_leaves(aux, axis_name)
-        params, new_opt = optimizer.update(params, grads, inner_opt)
-        if wrap_ef:
-            new_opt = {"opt": new_opt, "ef": new_ef}
+        params, new_opt = optimizer.update(params, grads, opt_state)
         return params, new_state, new_opt, loss, aux
 
-    opt_spec = (
-        {"opt": P(), "ef": compress_mod.ef_specs(axis_name)}
-        if wrap_ef
-        else P()
-    )
     mapped = jax.shard_map(
         spmd_step,
         mesh=mesh,
         in_specs=(
-            P(), P(), opt_spec,
+            P(), P(), P(),
             batch_spec if batch_spec is not None else P(axis_name),
             P(),
         ),
-        out_specs=(P(), P(), opt_spec, P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
     return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())
@@ -376,7 +326,7 @@ def make_train_step_auto(
     axis_name: str = DATA_AXIS,
     donate: bool = True,
 ):
-    """The compiler-driven alternative to `make_stateful_train_step`.
+    """The compiler-driven alternative to `make_spmd_train_step`.
 
     Instead of writing per-rank SPMD code with an explicit ``pmean``
     (the shard_map style that mirrors the reference's

@@ -1,25 +1,20 @@
-"""Fully-sharded data parallelism (ZeRO-3 style) over the ``data`` axis.
+"""FSDP flat-row layout utilities (shard/gather helpers).
 
-Beyond the reference (its DP replicates the model on every rank,
-train_dist.py:107 + tuto.md:216); this is the memory-scaled variant:
-parameters, gradients, and optimizer state are all sharded 1/n per rank,
-with parameters gathered just-in-time for compute.
-
-TPU-first design: everything happens inside ONE compiled shard_map
-program per step —
+The hand-written FSDP/ZeRO-1 *train-step builders* that used to live
+here are retired: `parallel.partition.make_partitioned_train_step` is
+the one sharded train step (the ``fsdp`` / ``zero1:dp`` rule sets), and
+the trainers' ``fsdp``/``zero1`` flags route through it.  What remains
+is the flat ``(n, k)`` row layout itself — still the storage format of
+pre-engine sharded checkpoints and a useful manual-sharding primitive:
 
 - each leaf is stored flattened and padded to ``(n, k)``, sharded
   ``P(axis)`` (rank r holds row r: 1/n of the leaf);
-- forward/backward: ``all_gather`` (tiled) un-shards each leaf to its
-  original shape, XLA overlapping the gathers with compute;
-- gradients: flat-pad then ``psum_scatter`` (XLA ReduceScatter) /n — each
-  rank reduces exactly its shard, wire cost identical to the allreduce
-  the replicated path pays (RS + AG == allreduce, tuto.md:354's identity);
-- update: the optimizer's elementwise pytree update runs on the local
-  (1, k) shards, so its state (momentum/adam moments) is born sharded.
+- `fsdp_shard_params` / `fsdp_gather_params` convert between logical
+  pytrees and the row layout;
+- `fsdp_gather_params_compiled` is the multi-host-safe compiled
+  all_gather reassembly (`fsdp_full_params` picks between them).
 
-Padding is benign: padded grads are zero, so padded param/opt entries
-stay exactly zero under SGD/momentum/AdamW.
+Padding is benign: padded entries are zero and stay zero.
 """
 
 from __future__ import annotations
@@ -34,16 +29,12 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_dist.parallel.data_parallel import DATA_AXIS, _pmean_float_leaves
+from tpu_dist.parallel.data_parallel import DATA_AXIS
 from tpu_dist.utils.tree import pad_to_multiple
 
 
 def _pad_rows(flat: jax.Array, n: int) -> jax.Array:
     return pad_to_multiple(flat, n).reshape(n, -1)
-
-
-# Shared building blocks of the ZeRO family (used by both ZeRO-3 and
-# ZeRO-1 steps — keep them in one place so a fix applies to both paths).
 
 
 def _unshard_rows(rows: Any, template: Any, axis_name: str) -> Any:
@@ -56,112 +47,6 @@ def _unshard_rows(rows: Any, template: Any, axis_name: str) -> Any:
 
     return jax.tree.map(un, rows, template)
 
-
-def _reduce_scatter_grads(grads: Any, n: int, axis_name: str) -> Any:
-    """Flat-pad each grad to (n, k) then ReduceScatter / n: rank r
-    reduces exactly its row (inside shard_map)."""
-    return jax.tree.map(
-        lambda g: lax.psum_scatter(
-            _pad_rows(jnp.ravel(g), n), axis_name,
-            scatter_dimension=0, tiled=True,
-        )
-        / n,
-        grads,
-    )
-
-
-def _compress_setup(grad_compress, grad_pmean_axes, builder: str):
-    """Parse/validate the compressed-reduce-scatter config for a ZeRO
-    builder (config-parse time, not trace time)."""
-    from tpu_dist.comm import compress as compress_mod
-
-    ccfg = compress_mod.parse(grad_compress)
-    if ccfg is not None and grad_pmean_axes:
-        compress_mod.refuse_model_axes(
-            builder,
-            grad_pmean_axes,
-            rules="grad_pmean_axes (the TP gradient contract)",
-        )
-    return ccfg, ccfg is not None and ccfg.error_feedback
-
-
-def _compressed_gshards(grads, opt_state, ccfg, wrap_ef, n, axis_name):
-    """The gradient hop of a ZeRO step: exact ``psum_scatter`` (ccfg
-    None) or the bucketed quantized reduce-scatter with error feedback
-    (`comm.compress.reduce_scatter_rows`).  Returns ``(gshards,
-    inner_opt_state, new_ef_or_None)`` — gshards in the per-leaf (1, k)
-    row format either way (inside shard_map)."""
-    if ccfg is None:
-        return _reduce_scatter_grads(grads, n, axis_name), opt_state, None
-    from tpu_dist.comm import compress as compress_mod
-
-    plan = compress_mod.FlatPlan(grads, n, ccfg)
-    res = opt_state["ef"]["residual"][0] if wrap_ef else None
-    local, new_res, stats = compress_mod.reduce_scatter_rows(
-        plan.to_rows(grads), res, plan, axis_name
-    )
-    gshards = plan.shard_rows(local / n)
-    inner = opt_state["opt"] if wrap_ef else opt_state
-    new_ef = (
-        {"residual": new_res[None], "err": stats["err"]} if wrap_ef else None
-    )
-    return gshards, inner, new_ef
-
-
-def _accumulate_grads(loss_grad_fn, params, batch, key, accum_steps: int):
-    """Microbatch gradient accumulation for the sharded step builders —
-    the stateless adapter over the shared scan
-    (`data_parallel.accumulate_microbatches`, one contract for DP and
-    ZeRO).  ``loss_grad_fn(full, micro_batch, key) -> ((loss, aux),
-    grads)`` on FULL logical params; returns ``(mean_grads, mean_loss,
-    aux)``."""
-    from tpu_dist.parallel.data_parallel import accumulate_microbatches
-
-    def gm(p, _state, mb, k):
-        (loss, aux), g = loss_grad_fn(p, mb, k)
-        return g, loss, _state, aux
-
-    grads, loss, _, aux = accumulate_microbatches(
-        gm, params, None, batch, key, accum_steps
-    )
-    return grads, loss, aux
-
-
-def _apply_grad_contract(grads, loss, aux, axis_name, grad_pmean_axes):
-    """The TP-composition tail shared by the ZeRO step builders: pmean
-    grads over the extra model axes (the tensor-parallel gradient
-    contract — the model-axis mean of a model-sharded loss's grads
-    equals the dense gradient), then reduce loss/aux over ALL axes so
-    their replicated out_specs are honest."""
-    if grad_pmean_axes:
-        grads = jax.tree.map(lambda g: lax.pmean(g, grad_pmean_axes), grads)
-    all_axes = (axis_name, *grad_pmean_axes)
-    return grads, lax.pmean(loss, all_axes), _pmean_float_leaves(aux, all_axes)
-
-
-def _batch_in_spec(batch_spec, axis_name: str):
-    """The batch partition spec (default: leading axis over the data
-    axis) — one definition for both ZeRO builders."""
-    return batch_spec if batch_spec is not None else P(axis_name)
-
-
-def _spec_of(axis_name: str):
-    """Per-leaf partition spec: (n, k) leaves sharded over the axis,
-    scalar leaves (e.g. a schedule step counter) replicated."""
-    return lambda leaf: P(axis_name) if jnp.ndim(leaf) >= 1 else P()
-
-
-def _commit_scalars(tree: Any, mesh: Mesh) -> Any:
-    """Commit scalar leaves (step counters) to the mesh, replicated:
-    uncommitted single-device scalars round-trip through sharded
-    checkpoints as committed device-0 arrays, which then clash with the
-    mesh-wide step at dispatch."""
-    return jax.tree.map(
-        lambda l: l
-        if jnp.ndim(l) >= 1
-        else jax.device_put(l, NamedSharding(mesh, P())),
-        tree,
-    )
 
 
 def fsdp_shard_params(params: Any, mesh: Mesh, axis_name: str = DATA_AXIS) -> Any:
@@ -199,31 +84,6 @@ def fsdp_gather_params(sharded: Any, template: Any) -> Any:
         template,
     )
 
-
-def _sharded_update_fn(optimizer, builder: str):
-    """The optimizer update to run on flat-padded PER-RANK rows, as
-    ``fn(params, grads, state, axis_name)``.
-
-    An optimizer advertising ``shard_update`` (e.g. `clip_by_global_norm`,
-    which psums squared shard norms to the true global norm) is used
-    as-is; otherwise the plain update is valid only when each element's
-    update depends on its own history alone — whole-tensor statistics
-    (adafactor's factoring/RMS clipping) would silently differ per world
-    size, so non-elementwise optimizers without a sharded form are
-    refused loudly."""
-    sharded = getattr(optimizer, "shard_update", None)
-    if sharded is not None:
-        return sharded
-    if not getattr(optimizer, "elementwise", True):
-        raise ValueError(
-            f"{builder} requires an elementwise optimizer (sgd/adamw) or "
-            "one with a shard_update (clip_by_global_norm provides one); "
-            "this optimizer carries whole-tensor statistics that per-rank "
-            "shards would compute differently at every world size"
-        )
-    return lambda params, grads, state, _axis: optimizer.update(
-        params, grads, state
-    )
 
 
 _GATHER_CACHE: "collections.OrderedDict" = collections.OrderedDict()
@@ -279,119 +139,6 @@ def fsdp_gather_params_compiled(
     return fn(sharded)
 
 
-def make_fsdp_train_step(
-    loss_fn: Callable[..., Any],
-    optimizer,
-    mesh: Mesh,
-    params: Any,
-    *,
-    axis_name: str = DATA_AXIS,
-    donate: bool = True,
-    grad_pmean_axes: tuple[str, ...] = (),
-    batch_spec=None,
-    accum_steps: int = 1,
-    grad_compress=None,
-):
-    """Build the compiled FSDP train step.
-
-    Args:
-      loss_fn: ``loss_fn(params, batch, key) -> (loss, aux)`` on the local
-        batch shard (same contract as `make_train_step`).
-      optimizer: `tpu_dist.train.optim.Optimizer`; its state is created
-        over the SHARDED leaves, so it is 1/n per rank by construction.
-      mesh: mesh whose ``axis_name`` axis shards batch AND model state.
-        May have MORE axes than ``axis_name`` — params/opt state are then
-        replicated over the extra axes and ``loss_fn`` is free to use
-        them (e.g. tensor parallelism over a 'model' axis).
-      params: the full initial parameter pytree (consumed: returned
-        sharded).
-      grad_pmean_axes: extra mesh axes to pmean gradients over BEFORE
-        the ``axis_name`` reduce-scatter.  For FSDP x TP composition
-        pass ``('model',)``: per the TP gradient contract
-        (test_tensor_parallel.py), the model-axis mean of
-        `loss_tensor_parallel` grads equals the dense gradient.
-      batch_spec: PartitionSpec for the batch (default ``P(axis_name)``)
-        — e.g. ``P('data', 'model')`` for the Megatron-SP layout, whose
-        token windows shard over batch AND sequence.
-      accum_steps: microbatch gradient accumulation (``lax.scan`` with a
-        gradient-sum carry, like the replicated DP step): activations
-        live one microbatch at a time; the reduce-scatter still fires
-        once per step on the mean gradient.  Params stay gathered for
-        the whole step (the per-microbatch re-gather trade is left to
-        XLA's scheduler).
-
-    Returns ``(step, sharded_params, opt_state)`` with
-    ``step(sharded_params, opt_state, batch, key) -> (sharded_params,
-    opt_state, loss, aux)`` — batch sharded on its leading axis, loss
-    replicated (pmean), params/opt-state permanently sharded.
-
-    ``grad_compress`` (a `comm.compress.CompressConfig` or spec string)
-    swaps the gradient ``psum_scatter`` for the bucketed quantized
-    reduce-scatter with error feedback (`comm.compress`): each rank
-    ships 1-byte (or bf16) bucket chunks instead of f32 and dequantizes
-    into its exact shard rows.  The returned ``opt_state`` then becomes
-    ``{"opt": <state>, "ef": <residual>}``; data-axis only (incompatible
-    with ``grad_pmean_axes``).
-    """
-    n = mesh.shape[axis_name]
-    if accum_steps < 1:
-        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    ccfg, wrap_ef = _compress_setup(
-        grad_compress, grad_pmean_axes, "make_fsdp_train_step"
-    )
-    opt_update = _sharded_update_fn(optimizer, "make_fsdp_train_step")
-    template = jax.tree.map(
-        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
-    )
-    sharded_params = fsdp_shard_params(params, mesh, axis_name)
-    opt_state = _commit_scalars(optimizer.init(sharded_params), mesh)
-    if wrap_ef:
-        from tpu_dist.comm import compress as compress_mod
-
-        opt_state = {
-            "opt": opt_state,
-            "ef": compress_mod.init_ef_state(
-                template, n, ccfg, mesh, axis_name
-            ),
-        }
-    vg = jax.value_and_grad(loss_fn, has_aux=True)
-
-    def spmd_step(local_shards, opt_state, batch, key):
-        key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        full = _unshard_rows(local_shards, template, axis_name)
-        if accum_steps == 1:
-            (loss, aux), grads = vg(full, batch, key)
-        else:
-            grads, loss, aux = _accumulate_grads(
-                vg, full, batch, key, accum_steps
-            )
-        grads, loss, aux = _apply_grad_contract(
-            grads, loss, aux, axis_name, grad_pmean_axes
-        )
-        gshards, inner_opt, new_ef = _compressed_gshards(
-            grads, opt_state, ccfg, wrap_ef, n, axis_name
-        )
-        new_shards, new_opt = opt_update(
-            local_shards, gshards, inner_opt, axis_name
-        )
-        if wrap_ef:
-            new_opt = {"opt": new_opt, "ef": new_ef}
-        return new_shards, new_opt, loss, aux
-
-    p_specs = jax.tree.map(_spec_of(axis_name), sharded_params)
-    o_specs = jax.tree.map(_spec_of(axis_name), opt_state)
-    mapped = jax.shard_map(
-        spmd_step,
-        mesh=mesh,
-        in_specs=(
-            p_specs, o_specs, _batch_in_spec(batch_spec, axis_name), P(),
-        ),
-        out_specs=(p_specs, o_specs, P(), P()),
-        check_vma=False,
-    )
-    step = jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
-    return step, sharded_params, opt_state
-
 
 def fsdp_full_params(
     sharded: Any, template: Any, mesh: Mesh, axis_name: str = DATA_AXIS
@@ -407,119 +154,4 @@ def fsdp_full_params(
     return fsdp_gather_params_compiled(sharded, template, mesh, axis_name)
 
 
-def make_zero1_train_step(
-    loss_fn: Callable[..., Any],
-    optimizer,
-    mesh: Mesh,
-    params: Any,
-    *,
-    axis_name: str = DATA_AXIS,
-    donate: bool = True,
-    accum_steps: int = 1,
-    grad_pmean_axes: tuple[str, ...] = (),
-    batch_spec=None,
-    grad_compress=None,
-):
-    """ZeRO-1: replicated parameters, SHARDED optimizer state — the
-    middle point between replicated DP and FSDP/ZeRO-3.
 
-    Forward/backward run on the full replicated params (none of ZeRO-3's
-    per-step parameter all_gathers); gradients are reduce-scattered so
-    each rank holds one (1, k) row of every padded-flat leaf and updates
-    only its row — optimizer state (momentum/Adam moments) is therefore
-    born sharded, 1/n memory per rank; the updated rows all_gather back
-    into full parameters.  RS + shard-update + AG costs the same wire
-    traffic as the replicated path's allreduce (the tuto.md:354
-    identity), and the elementwise optimizer math makes the trajectory
-    identical to replicated DP to fp tolerance.  (ZeRO-2's gradient
-    sharding is implicit here: the reduce-scatter means full gradients
-    never persist — XLA frees them within the step.)
-
-    ``accum_steps``, ``grad_pmean_axes``, and ``batch_spec`` carry the
-    same contracts as `make_fsdp_train_step` — in particular TP×ZeRO-1:
-    pass ``grad_pmean_axes=('model',)`` with a tensor-parallel loss on a
-    (data × model) mesh (and ``batch_spec=P('data','model')`` for the
-    SP layout) and the optimizer state shards over 'data' while the
-    loss runs model-sharded.
-
-    Returns ``(step, replicated_params, sharded_opt_state)`` with
-    ``step(params, opt_state, batch, key) -> (params, opt_state, loss,
-    aux)`` — params replicated, batch sharded on its leading axis.
-    """
-    n = mesh.shape[axis_name]
-    if accum_steps < 1:
-        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
-    ccfg, wrap_ef = _compress_setup(
-        grad_compress, grad_pmean_axes, "make_zero1_train_step"
-    )
-    opt_update = _sharded_update_fn(optimizer, "make_zero1_train_step")
-    vg = jax.value_and_grad(loss_fn, has_aux=True)
-    template = jax.tree.map(
-        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
-    )
-    replicated = jax.tree.map(
-        lambda p: jax.device_put(jnp.asarray(p), NamedSharding(mesh, P())),
-        params,
-    )
-    # Optimizer state over the (1, k)-per-rank row shards.
-    opt_state = _commit_scalars(
-        optimizer.init(fsdp_shard_params(params, mesh, axis_name)), mesh
-    )
-    if wrap_ef:
-        from tpu_dist.comm import compress as compress_mod
-
-        opt_state = {
-            "opt": opt_state,
-            "ef": compress_mod.init_ef_state(
-                template, n, ccfg, mesh, axis_name
-            ),
-        }
-
-    def local_rows(full):
-        """This rank's (1, k) row of each padded-flat leaf."""
-        r = lax.axis_index(axis_name)
-        return jax.tree.map(
-            lambda p: lax.dynamic_slice_in_dim(
-                _pad_rows(jnp.ravel(p), n), r, 1, axis=0
-            ),
-            full,
-        )
-
-    def spmd_step(full_params, opt_state, batch, key):
-        key = jax.random.fold_in(key, lax.axis_index(axis_name))
-        if accum_steps == 1:
-            (loss, aux), grads = vg(full_params, batch, key)
-        else:
-            grads, loss, aux = _accumulate_grads(
-                vg, full_params, batch, key, accum_steps
-            )
-        grads, loss, aux = _apply_grad_contract(
-            grads, loss, aux, axis_name, grad_pmean_axes
-        )
-        gshards, inner_opt, new_ef = _compressed_gshards(
-            grads, opt_state, ccfg, wrap_ef, n, axis_name
-        )
-        new_rows, new_opt = opt_update(
-            local_rows(full_params), gshards, inner_opt, axis_name
-        )
-        if wrap_ef:
-            new_opt = {"opt": new_opt, "ef": new_ef}
-        return (
-            _unshard_rows(new_rows, template, axis_name),
-            new_opt,
-            loss,
-            aux,
-        )
-
-    o_specs = jax.tree.map(_spec_of(axis_name), opt_state)
-    mapped = jax.shard_map(
-        spmd_step,
-        mesh=mesh,
-        in_specs=(
-            P(), o_specs, _batch_in_spec(batch_spec, axis_name), P(),
-        ),
-        out_specs=(P(), o_specs, P(), P()),
-        check_vma=False,
-    )
-    step = jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
-    return step, replicated, opt_state

@@ -75,6 +75,7 @@ __all__ = [
     "resolve_trainer_rules",
     "rule_match_report",
     "shard_over",
+    "strategy_engine_spec",
     "tree_paths",
 ]
 
@@ -506,6 +507,7 @@ def resolve_rules(
     *,
     user_rules=None,
     env: bool = True,
+    bind: dict[str, str] | None = None,
 ) -> RuleSet:
     """The `RuleSet` for a mesh_axes spec, validated against ``mesh``.
 
@@ -521,6 +523,14 @@ def resolve_rules(
       transformer param names, fsdp/catch-all for the rest; opt state
       picks up the dp axis (sharded update on every set but pure dp).
 
+    ``bind`` maps spec ROLE names onto the mesh's actual axis names
+    (e.g. ``{"fsdp": "data"}`` runs the fsdp rule set on a mesh whose
+    axis is called ``data``) — how the trainers route their legacy
+    fsdp/zero1/dp flags through the engine on the caller's existing
+    mesh without renaming its axes.  The `RuleSet`'s ``name`` (and
+    therefore checkpoint/telemetry provenance) stays role-based;
+    ``data_axes``/``model_axes`` and every rule carry the BOUND names.
+
     ``user_rules`` (list of ``(pattern, spec)``) and the
     ``TPU_DIST_RULES`` env (when ``env=True``) are matched ahead of the
     built-ins, env first — so a single layer can be pinned to a
@@ -528,8 +538,19 @@ def resolve_rules(
     params AND optimizer state (the update follows the pinned layout).
     """
     prefix, axes = parse_mesh_axes(spec)
+    bind = dict(bind or {})
+    if set(bind) - set(axes):
+        raise ValueError(
+            f"bind maps roles {sorted(set(bind) - set(axes))} that the "
+            f"mesh_axes spec {spec!r} does not name"
+        )
+    # role -> actual mesh axis name (identity unless bound)
+    actual = {role: bind.get(role, role) for role in axes}
     mesh_shape = {str(k): int(v) for k, v in dict(mesh.shape).items()}
-    want = {a: (s if s is not None else mesh_shape.get(a)) for a, s in axes.items()}
+    want = {
+        actual[a]: (s if s is not None else mesh_shape.get(actual[a]))
+        for a, s in axes.items()
+    }
     if tuple(want) != tuple(mesh.axis_names) or any(
         mesh_shape.get(a) != s for a, s in want.items()
     ):
@@ -538,16 +559,19 @@ def resolve_rules(
             f"(axes {mesh_shape}) — build the mesh with "
             f"partition.build_mesh({spec!r}) or align the spec"
         )
-    has_fsdp = FSDP_AXIS in want
-    has_tp = TP_AXIS in want
-    data_axes = tuple(a for a in want if a in (DP_AXIS, FSDP_AXIS))
+    has_fsdp = FSDP_AXIS in axes
+    has_tp = TP_AXIS in axes
+    fsdp_ax, tp_ax = actual.get(FSDP_AXIS), actual.get(TP_AXIS)
+    data_axes = tuple(
+        actual[a] for a in axes if a in (DP_AXIS, FSDP_AXIS)
+    )
 
-    catch_all = shard_over(FSDP_AXIS) if has_fsdp else _p_rule()
+    catch_all = shard_over(fsdp_ax) if has_fsdp else _p_rule()
     if has_tp:
-        param_rules = _megatron_rules(TP_AXIS)
+        param_rules = _megatron_rules(tp_ax)
         if has_fsdp:  # 2-D weight sharding: tp dim + fsdp on the rest
             param_rules = tuple(
-                (pat, _fill(val, (FSDP_AXIS,))) for pat, val in param_rules
+                (pat, _fill(val, (fsdp_ax,))) for pat, val in param_rules
             )
         param_rules += ((r".*", catch_all),)
     else:
@@ -556,12 +580,12 @@ def resolve_rules(
     # The sharded weight update: pure dp keeps the replicated update
     # (the baseline); every other set extends the param layout by the
     # data axes — optimizer state born 1/|dp| (ZeRO-1 for free).
-    name = prefix or "+".join(want)
+    name = prefix or "+".join(axes)
     plain_dp = name == DP_AXIS and not has_fsdp and not has_tp
     if plain_dp:
         opt_rules = param_rules
     else:
-        update_axes = (DP_AXIS,) if DP_AXIS in want else ()
+        update_axes = (actual[DP_AXIS],) if DP_AXIS in axes else ()
         opt_rules = tuple(
             (pat, _fill(val, update_axes)) for pat, val in param_rules
         )
@@ -572,7 +596,7 @@ def resolve_rules(
         param_rules=user + tuple(param_rules),
         opt_rules=user + tuple(opt_rules),
         data_axes=data_axes,
-        model_axes=(TP_AXIS,) if has_tp else (),
+        model_axes=(tp_ax,) if has_tp else (),
         n_user=len(user),
     )
 
@@ -587,40 +611,51 @@ def partition_summary(rules: RuleSet, mesh: Mesh) -> dict:
     }
 
 
+def strategy_engine_spec(
+    mesh: Mesh,
+    *,
+    fsdp: bool = False,
+    zero1: bool = False,
+    data_axis: str,
+    tp_axis: str | None = None,
+) -> tuple[str, dict[str, str]]:
+    """The ``(mesh_axes spec, bind)`` pair that routes the retired
+    fsdp/zero1/dp trainer FLAGS through the engine on the caller's
+    existing mesh — one synthesis for both trainers, so the flag→rule
+    translation cannot drift between them.  ``data_axis`` is the mesh's
+    batch axis (the legacy builders' ``'data'``); ``tp_axis`` composes
+    the Megatron tp vocabulary (the tensor_parallel flag's model axis).
+    Neither flag set means plain dp."""
+    if fsdp and zero1:
+        raise ValueError("fsdp and zero1 are mutually exclusive")
+    d = _axis_size(mesh, data_axis)
+    role = FSDP_AXIS if fsdp else DP_AXIS
+    prefix = "zero1:" if zero1 else ""
+    spec = f"{prefix}{role}={d}"
+    bind = {role: data_axis}
+    if tp_axis is not None:
+        spec += f",tp={_axis_size(mesh, tp_axis)}"
+        bind[TP_AXIS] = tp_axis
+    return spec, bind
+
+
 def resolve_trainer_rules(
     where: str,
     mesh: Mesh,
     mesh_axes: str,
     *,
     user_rules=None,
-    compress=None,
+    bind: dict[str, str] | None = None,
 ) -> tuple[RuleSet, dict]:
     """The shared trainer-side resolution (`Trainer` and `LMTrainer`
-    engine modes): rule set + checkpoint/telemetry summary, plus the
-    grad_compress refusal — naming the model-sharded axes and the rule
-    set when they are the reason, and saying plainly that the engine
-    has no compressed wire when they are not."""
-    rules = resolve_rules(mesh_axes, mesh, user_rules=user_rules)
-    meta = partition_summary(rules, mesh)
-    if compress is not None:
-        from tpu_dist.comm import compress as compress_mod
-
-        if rules.model_axes:
-            compress_mod.refuse_model_axes(
-                where,
-                rules.model_axes,
-                rules=f"partition rule set {rules.name!r}",
-                hint="The engine's gradient sync is derived by the "
-                "partitioner; the compressed wire only rides the "
-                "strategy step builders (fsdp/zero1 flags).",
-            )
-        raise ValueError(
-            f"{where}: grad_compress is not wired into the partition "
-            "engine — mesh_axes derives the gradient sync through the "
-            "XLA partitioner, not the compressed data-axis wire; use "
-            "the fsdp/zero1 strategy flags for compressed training"
-        )
-    return rules, meta
+    engine modes): rule set + checkpoint/telemetry summary.  The
+    compressed gradient wire is part of the engine itself
+    (`make_partitioned_train_step(compress=...)`), so there is no
+    trainer-level compress refusal here anymore — the only remaining
+    refusal (2-D model×data weight sharding) is raised by the step
+    builder, naming the offending leaves."""
+    rules = resolve_rules(mesh_axes, mesh, user_rules=user_rules, bind=bind)
+    return rules, partition_summary(rules, mesh)
 
 
 def gather_replicated(tree: Any, mesh: Mesh) -> Any:
@@ -685,6 +720,61 @@ def per_device_bytes(tree: Any, device=None) -> int:
 # ----------------------------------------------------------- train step
 
 
+def _strip_spec(spec: P, keep) -> P:
+    """``spec`` restricted to axis names in ``keep`` (tuples filtered,
+    empty entries -> None, trailing Nones trimmed) — how one leaf spec
+    splits into its manual (data) and auto (model) components for the
+    compressed-wire region."""
+    keep = set(keep)
+    out = []
+    for e in tuple(spec):
+        if e is None:
+            out.append(None)
+            continue
+        names = e if isinstance(e, tuple) else (e,)
+        kept = tuple(n for n in names if n in keep)
+        out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def _local_shape(shape, spec: P, axes, mesh: Mesh) -> tuple[int, ...]:
+    """The per-device shape of a leaf along ``axes`` only (other axis
+    names in the spec are ignored)."""
+    axes = set(axes)
+    out = list(shape)
+    for d, e in enumerate(tuple(spec)):
+        if e is None:
+            continue
+        for nme in e if isinstance(e, tuple) else (e,):
+            if nme in axes:
+                out[d] //= _axis_size(mesh, nme)
+    return tuple(out)
+
+
+def _gather_axes(leaf: jax.Array, spec: P, axes) -> jax.Array:
+    """Inside a manual region: all_gather the ``axes`` components of
+    ``spec`` back to the full leaf (tiled, per dim) — the per-step
+    un-shard of fsdp-ruled params the compressed region pays exactly
+    like GSPMD's derived gathers do."""
+    from jax import lax
+
+    axes = set(axes)
+    for d, e in enumerate(tuple(spec)):
+        if e is None:
+            continue
+        names = tuple(
+            n for n in (e if isinstance(e, tuple) else (e,)) if n in axes
+        )
+        if names:
+            leaf = lax.all_gather(
+                leaf, names if len(names) > 1 else names[0],
+                axis=d, tiled=True,
+            )
+    return leaf
+
+
 @dataclass
 class PartitionedTrainStep:
     """What `make_partitioned_train_step` hands back: the compiled step
@@ -701,6 +791,12 @@ class PartitionedTrainStep:
     # user-rule patterns that matched no parameter leaf (surfaced as a
     # warning event at build time and a `dead-rule` analyzer finding)
     dead_rules: tuple[str, ...] = ()
+    # the resolved compressed-wire config + flat bucket plan (None when
+    # the step syncs exact f32); plan shapes are per MODEL shard — the
+    # wire accounting and `analysis_expectations` the telemetry and the
+    # `compress-wire` lint consume
+    compress: Any = None
+    flat_plan: Any = field(repr=False, default=None)
 
     def summary(self) -> dict:
         return partition_summary(self.ruleset, self.mesh)
@@ -715,6 +811,7 @@ def make_partitioned_train_step(
     *,
     accum_steps: int = 1,
     donate: bool = True,
+    compress=None,
 ) -> PartitionedTrainStep:
     """ONE train step for every rule set — the engine's whole point.
 
@@ -733,12 +830,35 @@ def make_partitioned_train_step(
       (same contract as the strategy builders: one sync per step, mean
       gradient, activations 1/k).
 
+    ``compress`` (a `comm.compress.CompressConfig` or spec string like
+    ``"int8"``) swaps the partitioner-derived f32 gradient sync for the
+    bucketed quantized wire with two-round error feedback
+    (`comm.compress.all_reduce_rows`), INSIDE the same GSPMD program:
+    the loss/backward run in a shard_map region manual over the DATA
+    axes only (model axes stay auto — XLA still partitions the math
+    over tp), each data rank's gradient ships as 1-byte (or bf16)
+    bucket chunks through a compressed reduce-scatter + all-gather pair
+    per bucket, and the EF residual rides the optimizer-state slot as
+    ``{"opt": ..., "ef": {"residual", "err"}}``, sharded by the
+    engine's own rules and donated with it.  Model-sharded (tp) leaves
+    compress AT THEIR SHARD SHAPE — the wire reduces over the data
+    axes; model axes are untouched.  Per-rank loss keys are derived by
+    folding the data-axis coordinate into the step key, so dropout
+    masks differ across data ranks exactly like the retired strategy
+    builders' did.  The only refusal left: a leaf whose single dim is
+    sharded over BOTH a data and a model axis (mixed 2-D tuples) cannot
+    ride the wire.
+
     Returns a `PartitionedTrainStep`; its ``step(params, opt_state,
     batch, key) -> (params, opt_state, loss, aux)`` donates params/opt
     state when ``donate``.  The returned ``params``/``opt_state`` are
     freshly placed under the rules (safe to donate immediately)."""
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    from tpu_dist.comm import compress as compress_mod
+
+    ccfg = compress_mod.parse(compress)
+    wrap_ef = ccfg is not None and ccfg.error_feedback
     # Opt-state specs from the ABSTRACT init (eval_shape): the full
     # replicated state is never materialized — under an fsdp rule set
     # whose adamw moments only fit sharded, a concrete init here would
@@ -805,24 +925,212 @@ def make_partitioned_train_step(
         )
         return grads, lsum / accum_steps, aux
 
-    def global_step(params, opt_state, batch, key):
-        if accum_steps == 1:
-            (loss, aux), grads = vg(params, batch, key)
+    flat_plan = None
+    if ccfg is None:
+
+        def global_step(params, opt_state, batch, key):
+            if accum_steps == 1:
+                (loss, aux), grads = vg(params, batch, key)
+            else:
+                grads, loss, aux = accumulate(params, batch, key)
+            # The sharded weight update: pin the gradient (same shapes
+            # as params) to the UPDATE layout, so the optimizer's
+            # elementwise math — and the momenta it reads/writes —
+            # partitions with it instead of replicating (arxiv
+            # 2004.13336's transformation, expressed as a sharding
+            # constraint instead of a rewrite).
+            grads = jax.lax.with_sharding_constraint(grads, u_sh)
+            new_params, new_opt = optimizer.update(params, grads, opt_state)
+            return new_params, new_opt, loss, aux
+
+        o_sh_step = o_sh
+    else:
+        # ---- the compressed data-axis wire, inside the GSPMD program.
+        # Manual region over the DATA axes only (model axes stay auto):
+        # each data rank computes its local-shard gradient, ships it as
+        # quantized buckets through `all_reduce_rows`, and hands the
+        # data-replicated mean gradient back to the sharded update.
+        data_axes = tuple(rules.data_axes)
+        model_axes = tuple(rules.model_axes)
+        ax = data_axes if len(data_axes) > 1 else data_axes[0]
+        n_data = int(np.prod([_axis_size(mesh, a) for a in data_axes]))
+        p_leaves, p_treedef = jax.tree_util.tree_flatten(params)
+        spec_leaves = p_treedef.flatten_up_to(param_specs)
+        # A dim sharded over BOTH a data and a model axis (the 2-D
+        # tp×fsdp weight sharding) interleaves model and data shards in
+        # one dimension — the flat bucket layout cannot split that into
+        # a model-local row matrix.  Refuse loudly, naming the leaves.
+        mixed = [
+            path
+            for (path, _), spec in zip(tree_paths(params), spec_leaves)
+            for e in tuple(spec)
+            if isinstance(e, tuple)
+            and set(e) & set(data_axes)
+            and set(e) & set(model_axes)
+        ]
+        if mixed:
+            compress_mod.refuse_model_axes(
+                "make_partitioned_train_step(compress=...)",
+                model_axes,
+                rules=(
+                    f"rule set {rules.name!r}: leaves {sorted(set(mixed))} "
+                    "shard one dim over model AND data axes (2-D weight "
+                    "sharding)"
+                ),
+                hint="Use a mesh_axes spec whose model and data axes land "
+                "on different dims (e.g. dp×tp), or drop compress.",
+            )
+        # Shapes as the sync region sees them: full along data dims
+        # (params are gathered there), 1/|tp| along model-sharded dims.
+        local_tmpl = jax.tree_util.tree_unflatten(p_treedef, [
+            jax.ShapeDtypeStruct(
+                _local_shape(tuple(leaf.shape), spec, model_axes, mesh),
+                leaf.dtype,
+            )
+            for leaf, spec in zip(p_leaves, spec_leaves)
+        ])
+        flat_plan = compress_mod.FlatPlan(local_tmpl, n_data, ccfg)
+        res_spec = compress_mod.engine_residual_spec(data_axes, model_axes)
+        res_manual = _strip_spec(res_spec, data_axes)
+        g_model_specs = jax.tree_util.tree_unflatten(
+            p_treedef, [_strip_spec(s, model_axes) for s in spec_leaves]
+        )
+        manual_p_specs = jax.tree_util.tree_unflatten(
+            p_treedef, [_strip_spec(s, data_axes) for s in spec_leaves]
+        )
+        # nan_guard-wrapped optimizers advertise current_scale: poison
+        # grads on a non-finite LOSS before the sync so the wire's
+        # all-finite predicate holds the residual and the guard skips
+        # the step — the legacy builders' contract, kept.
+        guarded = getattr(optimizer, "current_scale", None) is not None
+
+        def sync_local(grads_local, residual_local):
+            """Leaves at MODEL-shard shapes; reduce over data axes."""
+            rows = flat_plan.to_rows(grads_local)
+            res = residual_local[0] if residual_local is not None else None
+            total, new_res, stats = compress_mod.all_reduce_rows(
+                rows, res, flat_plan, ax,
+                predicate_axes=data_axes + model_axes,
+            )
+            grads_mean = flat_plan.from_rows(total / n_data)
+            err = stats["err"]
+            if model_axes:
+                err = jax.lax.pmean(err, model_axes)
+            return (
+                grads_mean,
+                new_res[None] if new_res is not None else None,
+                err,
+            )
+
+        if model_axes:
+            m_ax = model_axes if len(model_axes) > 1 else model_axes[0]
+            inner_res_spec = P(None, None, m_ax)
+
+            def sync(grads, residual):
+                if wrap_ef:
+                    return jax.shard_map(
+                        sync_local,
+                        mesh=mesh,
+                        in_specs=(g_model_specs, inner_res_spec),
+                        out_specs=(g_model_specs, inner_res_spec, P()),
+                        check_vma=False,
+                    )(grads, residual)
+                def stateless(g_):
+                    out = sync_local(g_, None)
+                    return out[0], out[2]
+
+                g, e = jax.shard_map(
+                    stateless,
+                    mesh=mesh,
+                    in_specs=(g_model_specs,),
+                    out_specs=(g_model_specs, P()),
+                    check_vma=False,
+                )(grads)
+                return g, None, e
         else:
-            grads, loss, aux = accumulate(params, batch, key)
-        # The sharded weight update: pin the gradient (same shapes as
-        # params) to the UPDATE layout, so the optimizer's elementwise
-        # math — and the momenta it reads/writes — partitions with it
-        # instead of replicating (arxiv 2004.13336's transformation,
-        # expressed as a sharding constraint instead of a rewrite).
-        grads = jax.lax.with_sharding_constraint(grads, u_sh)
-        new_params, new_opt = optimizer.update(params, grads, opt_state)
-        return new_params, new_opt, loss, aux
+            sync = sync_local
+
+        def region(params, batch, key, residual):
+            # Per-rank keys: the data-axis coordinate folds into the
+            # step key, so dropout masks differ across data ranks (the
+            # strategy builders' per-rank stream, kept under the
+            # engine).
+            key = jax.random.fold_in(key, jax.lax.axis_index(ax))
+            full = jax.tree_util.tree_unflatten(p_treedef, [
+                _gather_axes(leaf, spec, data_axes)
+                for leaf, spec in zip(
+                    p_treedef.flatten_up_to(params), spec_leaves
+                )
+            ])
+            if accum_steps == 1:
+                (loss, aux), grads = vg(full, batch, key)
+            else:
+                grads, loss, aux = accumulate(full, batch, key)
+            if guarded:
+                from tpu_dist.resilience.guards import _poison
+
+                grads = _poison(grads, ~jnp.isfinite(loss))
+            grads, new_res, err = sync(grads, residual)
+            from tpu_dist.parallel.data_parallel import _pmean_float_leaves
+
+            loss = jax.lax.pmean(loss, ax)
+            aux = _pmean_float_leaves(aux, ax)
+            return grads, loss, aux, new_res, err
+
+        auto = frozenset(model_axes)
+        if wrap_ef:
+            mapped = jax.shard_map(
+                region,
+                mesh=mesh,
+                in_specs=(manual_p_specs, rules.batch_spec(), P(), res_manual),
+                out_specs=(P(), P(), P(), res_manual, P()),
+                check_vma=False,
+                auto=auto,
+            )
+        else:
+            mapped = jax.shard_map(
+                lambda p, b, k: region(p, b, k, None)[:3],
+                mesh=mesh,
+                in_specs=(manual_p_specs, rules.batch_spec(), P()),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+                auto=auto,
+            )
+
+        def global_step(params, opt_state, batch, key):
+            inner_opt = opt_state["opt"] if wrap_ef else opt_state
+            if wrap_ef:
+                grads, loss, aux, new_res, err = mapped(
+                    params, batch, key, opt_state["ef"]["residual"]
+                )
+            else:
+                grads, loss, aux = mapped(params, batch, key)
+            grads = jax.lax.with_sharding_constraint(grads, u_sh)
+            new_params, new_opt = optimizer.update(params, grads, inner_opt)
+            if wrap_ef:
+                new_opt = {
+                    "opt": new_opt,
+                    "ef": {"residual": new_res, "err": err},
+                }
+            return new_params, new_opt, loss, aux
+
+        if wrap_ef:
+            ef_sh = {
+                "residual": NamedSharding(mesh, res_spec),
+                "err": NamedSharding(mesh, P()),
+            }
+            o_sh_step = {"opt": o_sh, "ef": ef_sh}
+            opt_specs = {
+                "opt": opt_specs,
+                "ef": {"residual": res_spec, "err": P()},
+            }
+        else:
+            o_sh_step = o_sh
 
     step = jax.jit(
         global_step,
-        in_shardings=(p_sh, o_sh, b_sh, None),
-        out_shardings=(p_sh, o_sh, None, None),
+        in_shardings=(p_sh, o_sh_step, b_sh, None),
+        out_shardings=(p_sh, o_sh_step, None, None),
         donate_argnums=(0, 1) if donate else (),
     )
     placed_params = jax.tree_util.tree_map(
@@ -832,6 +1140,13 @@ def make_partitioned_train_step(
     # out-shardings, so each device writes only its own shard (no full
     # host copy, no device->host->device round trip).
     placed_opt = jax.jit(optimizer.init, out_shardings=o_sh)(placed_params)
+    if ccfg is not None and wrap_ef:
+        placed_opt = {
+            "opt": placed_opt,
+            "ef": compress_mod.init_engine_ef_state(
+                flat_plan, mesh, rules.data_axes, rules.model_axes
+            ),
+        }
     return PartitionedTrainStep(
         step=step,
         params=placed_params,
@@ -841,4 +1156,6 @@ def make_partitioned_train_step(
         ruleset=rules,
         mesh=mesh,
         dead_rules=dead,
+        compress=ccfg,
+        flat_plan=flat_plan,
     )

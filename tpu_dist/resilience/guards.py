@@ -12,12 +12,16 @@ training continues.
 For bf16 compute the guard also carries a dynamic loss scale with
 escalating backoff: on every bad step ``scale *= backoff``; after
 ``growth_interval`` consecutive good steps ``scale *= growth`` (clamped
-to ``[min_scale, max_scale]``).  The replicated step builders
-(`parallel.make_stateful_train_step` and its wrappers) read the live
-scale via ``current_scale`` and thread it through the loss/grad
-computation (scaled backward, unscaled grads + reported loss); under the
-FSDP/ZeRO-1 builders the guard provides skip-and-count only (the sharded
-builders do not thread a scale — documented in docs/resilience.md).
+to ``[min_scale, max_scale]``).  The explicit shard_map step
+(`parallel.make_spmd_train_step` and its wrappers) reads the live
+scale via ``current_scale`` and threads it through the loss/grad
+computation (scaled backward, unscaled grads + reported loss); the
+partition engine (`make_partitioned_train_step` — where the trainers'
+dp/fsdp/zero1 flags route) provides skip-and-count only and uses the
+guard's presence to poison gradients on a non-finite loss before the
+compressed wire's all-finite predicate (no scale threading — the
+trainers refuse ``loss_scale`` under engine-routed configs; documented
+in docs/resilience.md).
 
 Chaos: when ``TPU_DIST_CHAOS`` has a ``nan_step=K`` clause at wrapper
 construction time, the guard itself poisons the (post-reduce) gradient

@@ -582,8 +582,12 @@ def check_partition(
     if saved is None:
         raise ValueError(
             f"{where}: no partition metadata recorded — this checkpoint "
-            "was not written by a partition-engine (mesh_axes) trainer; "
-            "restore it with the trainer mode that wrote it"
+            "predates the partition engine (it was written by the "
+            "retired pre-PR-12 strategy builders or by a bare "
+            "save_sharded call).  Load it explicitly with "
+            "checkpoint.restore_sharded/restore_fsdp against templates "
+            "matching its saved layout, or re-export it from the run "
+            "that wrote it"
         )
     saved_axes = dict(saved.get("axes", {}))
     want_axes = dict(expected.get("axes", {}))
@@ -593,7 +597,13 @@ def check_partition(
             f"rule set {saved.get('rules')!r} (saved) vs "
             f"{expected.get('rules')!r} (this run)"
         )
-    if saved_axes != want_axes:
+    # Same rule set on the same AXIS NAMES but different sizes is a
+    # world resize: engine checkpoints store logical-shape leaves, so
+    # `restore_sharded` reshards them natively (per-rank state like the
+    # EF residual is shape-checked and reset separately,
+    # `compress.reset_resized_residual`).  Different axis NAMES mean a
+    # different topology — that is the elastic-resume case below.
+    if tuple(saved_axes) != tuple(want_axes):
         problems.append(
             f"mesh axes {saved_axes} (saved) vs {want_axes} (this run)"
         )

@@ -4,10 +4,12 @@ The reference's training loop is image classification (train_dist.py:
 103-127); `Trainer` reproduces it.  The LM family needs the same
 conveniences with different plumbing — token batches, next-token loss,
 perplexity instead of accuracy — so this is a sibling, built from the
-same parts: `parallel.make_stateful_train_step` (fused DP step with
-gradient pmean, accumulation, psum/ring/int8 reduce), the optimizer
-library (clipping/EMA/optax all compose), and `train.checkpoint`
-(async per-epoch writes).
+same parts: `parallel.make_partitioned_train_step` (the engine's one
+GSPMD step for dp/zero1/fsdp/tp rule sets, with accumulation and the
+optional compressed gradient wire; the model-sharded sequence/pipeline/
+moe modes ride `parallel.make_spmd_train_step`), the optimizer library
+(clipping/EMA/optax all compose), and `train.checkpoint` (async
+per-epoch writes).
 
 Determinism contract matches the reference (SURVEY.md §2c.6): seeded
 init, seeded per-epoch shuffles identical on every host, replicas
@@ -38,16 +40,17 @@ class LMTrainConfig:
     seed: int = 1234
     accum_steps: int = 1
     compute_dtype: str | None = None  # e.g. "bfloat16"
-    # ZeRO-3: params/grads/opt state sharded 1/n (LMs are stateless so
-    # the step swap is transparent; checkpoints switch to the sharded
-    # format; val perplexity / generate gather params as needed).
-    # Composes with accum_steps (microbatch scan inside the sharded
-    # step) and with tensor_parallel (HSDP: grad_pmean_axes applies the
-    # TP gradient contract before the data-axis reduce-scatter).
+    # ZeRO-3: params/grads/opt state sharded 1/n — routed through the
+    # partition engine (the 'fsdp' rule set bound to this mesh's 'data'
+    # axis; the legacy shard_map builder is retired).  Checkpoints use
+    # the sharded directory format with partition provenance; val
+    # perplexity / generate gather params as needed.  Composes with
+    # accum_steps and tensor_parallel (engine fsdp×tp rules).
     fsdp: bool = False
-    # ZeRO-1: params replicated, optimizer state sharded 1/n.  Mutually
-    # exclusive with fsdp; same sharded checkpoint format; composes
-    # with accum_steps and tensor_parallel (like fsdp).
+    # ZeRO-1: params replicated, optimizer state sharded 1/n — the
+    # engine's 'zero1:dp' rule set.  Mutually exclusive with fsdp; same
+    # sharded checkpoint format; composes with accum_steps and
+    # tensor_parallel (like fsdp).
     zero1: bool = False
     # Tensor parallelism over a 2-D (data x model) mesh: "psum" = the
     # classic Megatron layout (replicated activations, two psums per
@@ -89,17 +92,21 @@ class LMTrainConfig:
     # shards over 'data' as usual and every MoE layer all_to_all-dispatches
     # tokens to their routed experts (`TransformerLM.loss_moe_ep`, with
     # the balance-loss regularizer).  The gradient contract is the
-    # uniform data-axis pmean the step already applies, so this composes
-    # with fsdp/zero1/accum_steps; mutually exclusive with the other
-    # model-sharding modes.
+    # uniform data-axis pmean the step already applies; composes with
+    # accum_steps.  NOT combinable with fsdp/zero1 anymore (those route
+    # through the engine, and expert dispatch is not a rule vocabulary
+    # yet); mutually exclusive with the other model-sharding modes.
     moe: bool = False
-    # Bucketed error-feedback compressed gradient sync (comm.compress):
-    # a wire spec like 'int8' / 'fp8' / 'float8_e5m2' / 'bf16'.  Works in
-    # dp (compressed allreduce) and fsdp/zero1 (compressed
-    # reduce-scatter); the quantization residual is step state riding
-    # the optimizer-state checkpoint.  None = follow TPU_DIST_COMPRESS;
-    # 'off' = force-disable.  Mutually exclusive with the model-sharding
-    # modes (tensor/sequence/pipeline/moe).
+    # Bucketed error-feedback compressed gradient sync, INSIDE the
+    # partition engine's GSPMD step (comm.compress): a wire spec like
+    # 'int8' / 'fp8' / 'float8_e5m2' / 'bf16'.  Works on every
+    # engine-routed config — dp, fsdp, zero1, composed mesh_axes
+    # (dp×fsdp, dp×tp: model-sharded grads compress at their shard
+    # shape over the data axes).  The EF residual rides the
+    # optimizer-state checkpoint.  None = follow TPU_DIST_COMPRESS;
+    # 'off' = force-disable.  Refused by the shard_map-only modes
+    # (sequence/pipeline/moe, and the tensor_parallel flag without
+    # fsdp/zero1 — use mesh_axes 'dp=A,tp=B' instead).
     grad_compress: str | None = None
     # Global-norm gradient clipping (LM-training staple).  Wraps the
     # optimizer in `train.clip_by_global_norm`, whose shard_update psums
@@ -127,7 +134,8 @@ class LMTrainConfig:
     # sharded over the data axes, composed 2-D/3-D meshes from one
     # knob.  The mesh must carry exactly these axes
     # (partition.build_mesh).  Mutually exclusive with every strategy
-    # flag (fsdp/zero1/tensor/sequence/pipeline/moe) and grad_compress.
+    # flag (fsdp/zero1/tensor/sequence/pipeline/moe); grad_compress
+    # composes (the quantized wire rides inside the engine step).
     mesh_axes: str | None = None
     # Per-model overrides for the engine: (regex, spec) pairs matched
     # ahead of the built-ins (TPU_DIST_RULES env rules come first).
@@ -168,45 +176,61 @@ class LMTrainer:
                 self.optimizer, self.config.grad_clip
             )
 
-        self._engine_mode = self.config.mesh_axes is not None
-        self._sharded_mode = (
-            self.config.fsdp or self.config.zero1 or self._engine_mode
-        )
         # Compressed gradient sync: resolved (and VALIDATED — a typo'd
         # wire dtype fails here, not at trace time) from config or the
-        # TPU_DIST_COMPRESS env var.
+        # TPU_DIST_COMPRESS env var.  The wire itself lives INSIDE the
+        # partition engine (`make_partitioned_train_step(compress=)`).
         from tpu_dist.comm import compress as compress_mod
 
         self._compress = compress_mod.resolve(self.config.grad_compress)
         self._wrap_ef = (
             self._compress is not None and self._compress.error_feedback
         )
-        # Compressed replicated training checkpoints via the SHARDED
-        # directory format too: the error-feedback residual is per-rank
-        # (sharded P(data)), which the single-writer npz cannot hold on
-        # a multi-process mesh.
-        self._sharded_ckpt = self._sharded_mode or self._wrap_ef
-        # Partition-engine mode: rule set resolved (and the mesh
-        # validated against the spec) at config time.
+        if self.config.fsdp and self.config.zero1:
+            raise ValueError("fsdp and zero1 are mutually exclusive")
+        tp = self.config.tensor_parallel
+        sp = self.config.sequence_parallel
+        pp = self.config.pipeline
+        moe = self.config.moe
+        if sum(x is not None for x in (tp, sp, pp)) + bool(moe) > 1:
+            raise ValueError(
+                "tensor_parallel, sequence_parallel, pipeline, and moe "
+                "are mutually exclusive trainer modes"
+            )
+        if tp is not None and tp not in ("psum", "sp"):
+            raise ValueError(
+                f"tensor_parallel must be 'psum' or 'sp', got {tp!r}"
+            )
+        if (
+            tp is not None
+            and self.config.mesh_axes is None
+            and self.config.model_axis not in mesh.axis_names
+        ):
+            raise ValueError(
+                f"tensor_parallel needs a {self.config.model_axis!r} "
+                f"mesh axis; mesh has {mesh.axis_names}"
+            )
+        # Partition-engine routing: mesh_axes explicitly, or the legacy
+        # fsdp/zero1/dp flags (± tensor_parallel) bound onto this mesh's
+        # own axis names — ONE GSPMD step, one rule language (ROADMAP
+        # item 2(d)).  The model-sharded LM modes that are not yet a
+        # rule vocabulary (sequence/pipeline/moe, and tensor_parallel on
+        # replicated params) keep the explicit shard_map step.
         self._ruleset = None
         self._partition_meta = None
-        if self._engine_mode:
+        engine_spec, engine_bind = None, None
+        if self.config.mesh_axes is not None:
             if self.config.fsdp or self.config.zero1:
                 raise ValueError(
                     "mesh_axes selects a partition rule set — it replaces "
                     "the fsdp/zero1 strategy flags, do not combine them"
                 )
-            if (
-                self.config.tensor_parallel is not None
-                or self.config.sequence_parallel is not None
-                or self.config.pipeline is not None
-                or self.config.moe
-            ):
+            if tp is not None or sp is not None or pp is not None or moe:
                 raise ValueError(
                     "mesh_axes is a rule-set mode of its own — tensor/"
-                    "sequence/pipeline/moe flags select the strategy step "
-                    "builders instead; express tp composition as a 'tp' "
-                    "axis in mesh_axes (e.g. 'dp=2,tp=2')"
+                    "sequence/pipeline/moe flags select the explicit "
+                    "shard_map step instead; express tp composition as a "
+                    "'tp' axis in mesh_axes (e.g. 'dp=2,tp=2')"
                 )
             if self.config.loss_scale is not None:
                 raise ValueError(
@@ -214,22 +238,84 @@ class LMTrainer:
                     "step — use nan_guard without loss_scale under "
                     "mesh_axes"
                 )
+            engine_spec = self.config.mesh_axes
+        elif self.config.fsdp or self.config.zero1:
+            which = "fsdp" if self.config.fsdp else "zero1"
+            if sp is not None:
+                raise ValueError(
+                    "sequence_parallel is not combinable with fsdp/zero1 "
+                    "in the trainer (compose via "
+                    "parallel.make_spmd_train_step's batch_spec instead)"
+                )
+            if pp is not None:
+                raise ValueError(
+                    "pipeline is not combinable with fsdp/zero1 in the "
+                    "trainer (stage params already partition the model)"
+                )
+            if moe:
+                raise ValueError(
+                    f"moe is not combinable with {which} anymore: "
+                    "fsdp/zero1 route through the partition engine, and "
+                    "the expert all_to_all dispatch is not a rule "
+                    "vocabulary yet — drop moe or the sharding flag"
+                )
+            if self.config.loss_scale is not None:
+                raise ValueError(
+                    "loss_scale is not threaded through the fsdp/zero1 "
+                    "engine step — use nan_guard without loss_scale "
+                    "there (skip-and-count still applies)"
+                )
+            data_ax = parallel.DATA_AXIS
+            if data_ax not in mesh.axis_names:
+                raise ValueError(
+                    f"{which} expects a {data_ax!r} mesh axis; mesh has "
+                    f"{tuple(mesh.axis_names)} — use mesh_axes to name "
+                    "axes explicitly"
+                )
+            if tp is None and len(mesh.axis_names) != 1:
+                raise ValueError(
+                    f"{which} without tensor_parallel expects a 1-D "
+                    f"{data_ax!r} mesh (got {tuple(mesh.axis_names)}); "
+                    "use mesh_axes for composed meshes"
+                )
+            # fsdp/zero1 × tensor_parallel: the engine's tp rule
+            # vocabulary takes over (both the 'psum' and 'sp' layouts
+            # are GSPMD's call now — same global math).
+            engine_spec, engine_bind = parallel.strategy_engine_spec(
+                mesh, fsdp=self.config.fsdp, zero1=self.config.zero1,
+                data_axis=data_ax,
+                tp_axis=self.config.model_axis if tp is not None else None,
+            )
+        elif (
+            tp is None and sp is None and pp is None and not moe
+            and self.config.loss_scale is None
+            and tuple(mesh.axis_names) == (parallel.DATA_AXIS,)
+        ):
+            # plain dp on the standard 1-D mesh → engine
+            engine_spec, engine_bind = parallel.strategy_engine_spec(
+                mesh, data_axis=parallel.DATA_AXIS
+            )
+        self._engine_mode = engine_spec is not None
+        self._sharded_mode = (
+            self.config.fsdp or self.config.zero1
+            or self.config.mesh_axes is not None
+        )
+        # Compressed training checkpoints via the SHARDED directory
+        # format too: the error-feedback residual is per-rank (sharded
+        # over the data axes), which the single-writer npz cannot hold
+        # on a multi-process mesh.
+        self._sharded_ckpt = self._sharded_mode or self._wrap_ef
+        if self._engine_mode:
             self._ruleset, self._partition_meta = (
                 parallel.resolve_trainer_rules(
-                    "LMTrainer(mesh_axes=...)", mesh, self.config.mesh_axes,
+                    "LMTrainer", mesh, engine_spec,
                     user_rules=self.config.partition_rules,
-                    compress=self._compress,
+                    bind=engine_bind,
                 )
             )
         if self.config.loss_scale is not None and not self.config.nan_guard:
             raise ValueError("loss_scale requires nan_guard=True")
         if self.config.nan_guard:
-            if self.config.loss_scale is not None and self._sharded_mode:
-                raise ValueError(
-                    "loss_scale is not threaded through the fsdp/zero1 "
-                    "step builders — use nan_guard without loss_scale "
-                    "there (skip-and-count still applies)"
-                )
             from tpu_dist.resilience.guards import nan_guard
 
             # Outermost wrapper (over grad_clip): the step builder reads
@@ -244,30 +330,46 @@ class LMTrainer:
                 self.optimizer = nan_guard(
                     self.optimizer, init_scale=self.config.loss_scale
                 )
-        if self.config.fsdp and self.config.zero1:
-            raise ValueError("fsdp and zero1 are mutually exclusive")
-        tp = self.config.tensor_parallel
-        sp = self.config.sequence_parallel
-        pp = self.config.pipeline
-        moe = self.config.moe
-        if sum(x is not None for x in (tp, sp, pp)) + bool(moe) > 1:
-            raise ValueError(
-                "tensor_parallel, sequence_parallel, pipeline, and moe "
-                "are mutually exclusive trainer modes"
-            )
-        if self._compress is not None and (
-            tp is not None or sp is not None or pp is not None or moe
-        ):
-            mode_axes, mode = [], None
+        if self._compress is not None and not self._engine_mode:
+            # The compressed wire IS the engine's now: the model-sharded
+            # LM modes that still run the explicit shard_map step cannot
+            # carry it.  tensor_parallel could — through the engine —
+            # so its refusal points there; sequence/pipeline/moe
+            # genuinely lack a compressed path.
             if tp is not None:
-                mode_axes, mode = [self.config.model_axis], f"tensor_parallel={tp!r}"
-            elif sp is not None:
-                mode_axes, mode = [self.config.seq_axis], f"sequence_parallel={sp!r}"
-            elif pp is not None:
-                mode_axes, mode = [self.config.pipe_axis], f"pipeline={pp!r}"
-            elif moe:
-                mode = "moe=True (expert all_to_all over the data axis)"
-            compress_mod.refuse_model_axes("LMTrainer", mode_axes, rules=mode)
+                compress_mod.refuse_model_axes(
+                    "LMTrainer", [self.config.model_axis],
+                    rules=f"tensor_parallel={tp!r}",
+                    hint="mesh_axes engine mode (e.g. 'dp=2,tp=2') "
+                    "carries the compressed wire over the data axes of "
+                    "a tp mesh — use it instead of the tensor_parallel "
+                    "flag.",
+                )
+            if sp is not None or pp is not None or moe:
+                mode_axes, mode = [], None
+                if sp is not None:
+                    mode_axes, mode = (
+                        [self.config.seq_axis], f"sequence_parallel={sp!r}"
+                    )
+                elif pp is not None:
+                    mode_axes, mode = (
+                        [self.config.pipe_axis], f"pipeline={pp!r}"
+                    )
+                else:
+                    mode = "moe=True (expert all_to_all over the data axis)"
+                compress_mod.refuse_model_axes(
+                    "LMTrainer", mode_axes, rules=mode,
+                    hint="No engine rule vocabulary exists for this mode "
+                    "yet (ROADMAP item 2), so there is no compressed "
+                    "wire for it either.",
+                )
+            raise ValueError(
+                "LMTrainer: grad_compress rides the partition engine's "
+                "quantized wire — this configuration routes through the "
+                "explicit shard_map step (loss_scale or a non-'data' "
+                "mesh); drop the conflicting option or use mesh_axes "
+                "engine mode"
+            )
         if moe:
             world_data = mesh.shape.get(parallel.DATA_AXIS)
             if getattr(lm, "moe_experts", 0) != world_data:
@@ -275,27 +377,11 @@ class LMTrainer:
                     f"moe mode needs lm.moe_experts == data-axis size "
                     f"({world_data}), got {getattr(lm, 'moe_experts', 0)}"
                 )
-        if tp is not None:
-            if tp not in ("psum", "sp"):
-                raise ValueError(
-                    f"tensor_parallel must be 'psum' or 'sp', got {tp!r}"
-                )
-            if self.config.model_axis not in mesh.axis_names:
-                raise ValueError(
-                    f"tensor_parallel needs a {self.config.model_axis!r} "
-                    f"mesh axis; mesh has {mesh.axis_names}"
-                )
         if sp is not None:
             if sp not in ("ring", "ulysses"):
                 raise ValueError(
                     f"sequence_parallel must be 'ring' or 'ulysses', "
                     f"got {sp!r}"
-                )
-            if self._sharded_mode:
-                raise ValueError(
-                    "sequence_parallel is not combinable with fsdp/zero1 "
-                    "in the trainer (compose via "
-                    "parallel.make_fsdp_train_step's batch_spec instead)"
                 )
             if self.config.seq_axis not in mesh.axis_names:
                 raise ValueError(
@@ -307,11 +393,6 @@ class LMTrainer:
             if pp not in ("gpipe", "1f1b"):
                 raise ValueError(
                     f"pipeline must be 'gpipe' or '1f1b', got {pp!r}"
-                )
-            if self._sharded_mode:
-                raise ValueError(
-                    "pipeline is not combinable with fsdp/zero1 in the "
-                    "trainer (stage params already partition the model)"
                 )
             if self.config.pipe_axis not in mesh.axis_names:
                 raise ValueError(
@@ -434,6 +515,7 @@ class LMTrainer:
             built = parallel.make_partitioned_train_step(
                 engine_loss, self.optimizer, mesh, params, self._ruleset,
                 accum_steps=self.config.accum_steps,
+                compress=self._compress,
             )
             self.params, self.opt_state = built.params, built.opt_state
             self._param_template = jax.tree.map(
@@ -446,42 +528,6 @@ class LMTrainer:
                 return p2, ms, o2, loss, aux
 
             self.step = engine_step
-        elif self._sharded_mode:
-            def fsdp_loss(p, batch, key):
-                (tokens,) = batch
-                return mode_loss(p, tokens), {}
-
-            if self.config.fsdp:
-                fstep, p_sh, o_sh = parallel.make_fsdp_train_step(
-                    fsdp_loss, self.optimizer, mesh, params,
-                    accum_steps=self.config.accum_steps,
-                    grad_pmean_axes=(
-                        (self.config.model_axis,) if tp is not None else ()
-                    ),
-                    batch_spec=self._batch_spec,
-                    grad_compress=self._compress,
-                )
-            else:
-                fstep, p_sh, o_sh = parallel.make_zero1_train_step(
-                    fsdp_loss, self.optimizer, mesh, params,
-                    accum_steps=self.config.accum_steps,
-                    grad_pmean_axes=(
-                        (self.config.model_axis,) if tp is not None else ()
-                    ),
-                    batch_spec=self._batch_spec,
-                    grad_compress=self._compress,
-                )
-            assert_no_aliasing(p_sh, o_sh)
-            self.params, self.opt_state = p_sh, o_sh
-            self._param_template = jax.tree.map(
-                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
-            )
-
-            def fsdp_step(p, ms, os_, batch, key):
-                p2, o2, loss, aux = fstep(p, os_, batch, key)
-                return p2, ms, o2, loss, aux
-
-            self.step = fsdp_step
         else:
             extra = ()
             if tp is not None:
@@ -489,18 +535,11 @@ class LMTrainer:
             elif sp is not None:
                 extra = (self.config.seq_axis,)
             self.params = parallel.replicate(params, mesh)
-            inner_opt = parallel.replicate(self.optimizer.init(params), mesh)
-            if self._wrap_ef:
-                # The error-feedback residual rides the opt-state slot
-                # (per-rank step state, checkpointed with the optimizer).
-                self.opt_state = compress_mod.wrap_opt_state(
-                    inner_opt, params, mesh.shape[parallel.DATA_AXIS],
-                    self._compress, mesh, parallel.DATA_AXIS,
-                )
-            else:
-                self.opt_state = inner_opt
+            self.opt_state = parallel.replicate(
+                self.optimizer.init(params), mesh
+            )
             assert_no_aliasing(self.params, self.opt_state)
-            self.step = parallel.make_stateful_train_step(
+            self.step = parallel.make_spmd_train_step(
                 loss_fn, self.optimizer, mesh,
                 accum_steps=self.config.accum_steps,
                 extra_grad_axes=extra,
@@ -510,7 +549,6 @@ class LMTrainer:
                     (self.config.pipe_axis,) if pp is not None else ()
                 ),
                 batch_spec=self._batch_spec,
-                grad_compress=self._compress,
             )
         self._model_state = parallel.replicate({}, mesh)
         # Pipeline-schedule accounting for telemetry (static per step):
@@ -528,29 +566,21 @@ class LMTrainer:
                 "stash_depth": sched.stash_depth,
             }
         # Wire accounting for telemetry (static per step): what the
-        # compressed sync ships vs what exact fp32 would.
+        # engine's compressed sync ships vs what exact fp32 would.
         self._compress_summary = None
         if self._compress is not None:
-            self._compress_summary = compress_mod.FlatPlan(
-                params, mesh.shape[parallel.DATA_AXIS], self._compress
-            ).wire_summary(
-                "reduce_scatter" if self._sharded_mode else "all_reduce"
+            self._compress_summary = self._partition.flat_plan.wire_summary(
+                "all_reduce"
             )
 
     def _full_params(self):
         """Full (logical-shape) parameters for eval/decode — identity for
-        the replicated path, shard reassembly under FSDP, a compiled
-        all-gather for rule-sharded engine state on multi-process meshes
-        (fully-addressable engine shards pass through — jnp reads them
-        directly)."""
+        the replicated path, a compiled all-gather for rule-sharded
+        engine state on multi-process meshes (fully-addressable engine
+        shards pass through — jnp reads them directly)."""
         if self._engine_mode:
             return parallel.gather_replicated(self.params, self.mesh)
-        if not self.config.fsdp:
-            return self.params
-        return parallel.fsdp_full_params(
-            self.params, self._param_template, self.mesh,
-            parallel.DATA_AXIS,  # the axis make_fsdp_train_step sharded over
-        )
+        return self.params
 
     def fit(
         self,

@@ -56,26 +56,32 @@ class TrainConfig:
     accum_steps: int = 1
     # FSDP (ZeRO-3): params/grads/optimizer state sharded 1/n over the
     # mesh axis instead of replicated; checkpoints switch to the sharded
-    # per-shard-file format.  Numerics identical to replicated DP (the
-    # update is elementwise — tested in test_fsdp.py).
+    # per-shard-file format.  Routed through the partition engine (the
+    # 'fsdp' rule set bound to this mesh's axis) — the legacy shard_map
+    # builder is retired; numerics still match replicated DP (the
+    # update is elementwise — tested in test_partition.py).
     fsdp: bool = False
     # ZeRO-1: params replicated, optimizer state sharded 1/n (the memory
     # middle point; same wire cost and trajectory as replicated DP).
-    # Mutually exclusive with fsdp; same sharded checkpoint format.
+    # Mutually exclusive with fsdp; same sharded checkpoint format;
+    # routed through the engine like fsdp.
     zero1: bool = False
     # Gradient-reduction backend: 'psum' (XLA AllReduce, exact,
     # default), 'ring' (the hand-rolled chunked ppermute ring, exact),
     # 'int8' / 'fp8' (per-leaf quantized, 4x less ICI traffic, lossy at
     # gradient-noise level).  Replicated-DP mode only.
     grad_reduce: str = "psum"
-    # Bucketed error-feedback compressed gradient sync (comm.compress):
-    # a wire spec like 'int8' / 'fp8' / 'float8_e5m2' / 'bf16' (optionally
-    # 'int8,bucket_mb=4,block=256').  Works in dp AND fsdp/zero1 (the
-    # reduce-scatter hop compresses too); the quantization residual is
-    # train-step state that rides the optimizer-state checkpoint —
-    # which therefore uses the sharded DIRECTORY format (the residual
-    # is per-rank, so a single-writer npz cannot hold it multi-host).
-    # None = follow the TPU_DIST_COMPRESS env var; 'off' = force-disable.
+    # Bucketed error-feedback compressed gradient sync, riding INSIDE
+    # the partition engine's GSPMD step (comm.compress): a wire spec
+    # like 'int8' / 'fp8' / 'float8_e5m2' / 'bf16' (optionally
+    # 'int8,bucket_mb=4,block=256').  Works on every engine-routed
+    # config — dp, fsdp, zero1, composed mesh_axes; the quantization
+    # residual is train-step state that rides the optimizer-state
+    # checkpoint — which therefore uses the sharded DIRECTORY format
+    # (the residual is per-rank, so a single-writer npz cannot hold it
+    # multi-host).  Requires a stateless model, grad_reduce='psum', and
+    # no loss_scale (those need the explicit shard_map step, which has
+    # no wire).  None = follow TPU_DIST_COMPRESS; 'off' = force-disable.
     grad_compress: str | None = None
     # NaN guard (resilience.nan_guard): fused non-finite detection on
     # loss/grads inside the compiled step — a bad step is skipped
@@ -143,7 +149,8 @@ class Trainer:
         self._loss = loss
         # Compressed gradient sync: resolved (and VALIDATED — a typo'd
         # wire dtype fails here, not at trace time) from config or the
-        # TPU_DIST_COMPRESS env var.
+        # TPU_DIST_COMPRESS env var.  The wire itself lives INSIDE the
+        # partition engine now (`make_partitioned_train_step(compress=)`).
         from tpu_dist.comm import compress as compress_mod
 
         self._compress = compress_mod.resolve(self.config.grad_compress)
@@ -155,11 +162,22 @@ class Trainer:
                 "grad_compress replaces the gradient reduce — leave "
                 f"grad_reduce='psum', not {self.config.grad_reduce!r}"
             )
-        # Partition-engine mode: the rule set is resolved (and the mesh
-        # validated against the spec) at CONFIG time, so a typo'd axis
-        # or a mis-shaped mesh fails here, not at trace time.
+        if self.config.fsdp and self.config.zero1:
+            raise ValueError("fsdp and zero1 are mutually exclusive")
+        key = jax.random.key(self.config.seed)
+        params, state = model.init(key, in_shape)
+        stateless = not jax.tree.leaves(state)
+        # Partition-engine routing: mesh_axes explicitly, or the legacy
+        # fsdp/zero1/dp flags bound onto this mesh's own axis names —
+        # the rule set is resolved (and the mesh validated) at CONFIG
+        # time, so a typo'd axis or a mis-shaped mesh fails here, not at
+        # trace time.  Plain dp stays on the explicit shard_map builder
+        # only when something genuinely needs it: model state (BatchNorm
+        # statistics), a non-psum grad_reduce backend, or the dynamic
+        # loss scale.
         self._ruleset = None
         self._partition_meta = None
+        engine_spec, engine_bind = None, None
         if self.config.mesh_axes is not None:
             if self.config.fsdp or self.config.zero1:
                 raise ValueError(
@@ -170,7 +188,7 @@ class Trainer:
                 raise ValueError(
                     "mesh_axes routes the gradient sync through the XLA "
                     f"partitioner; grad_reduce={self.config.grad_reduce!r} "
-                    "only applies to the strategy step builders"
+                    "only applies to the explicit shard_map step"
                 )
             if self.config.loss_scale is not None:
                 raise ValueError(
@@ -178,24 +196,59 @@ class Trainer:
                     "step — use nan_guard without loss_scale under "
                     "mesh_axes"
                 )
+            engine_spec = self.config.mesh_axes
+        elif self.config.fsdp or self.config.zero1:
+            if len(mesh.axis_names) != 1:
+                raise ValueError(
+                    "TrainConfig.fsdp/zero1 expect a 1-D mesh (got axes "
+                    f"{tuple(mesh.axis_names)}); express multi-axis "
+                    "sharding as a mesh_axes spec instead"
+                )
+            if self.config.grad_reduce != "psum":
+                raise ValueError(
+                    "fsdp/zero1 route through the partition engine; "
+                    f"grad_reduce={self.config.grad_reduce!r} only "
+                    "applies to replicated data-parallel training"
+                )
+            if self.config.loss_scale is not None:
+                raise ValueError(
+                    "loss_scale is not threaded through the fsdp/zero1 "
+                    "engine step — use nan_guard without loss_scale "
+                    "there (skip-and-count still applies)"
+                )
+            engine_spec, engine_bind = parallel.strategy_engine_spec(
+                mesh, fsdp=self.config.fsdp, zero1=self.config.zero1,
+                data_axis=str(mesh.axis_names[0]),
+            )
+        elif (
+            stateless
+            and len(mesh.axis_names) == 1
+            and self.config.grad_reduce == "psum"
+            and self.config.loss_scale is None
+        ):
+            # plain dp, nothing the explicit builder is needed for —
+            # one engine, one rule language (ROADMAP item 2(d))
+            engine_spec, engine_bind = parallel.strategy_engine_spec(
+                mesh, data_axis=str(mesh.axis_names[0])
+            )
+        if engine_spec is not None:
             self._ruleset, self._partition_meta = (
                 parallel.resolve_trainer_rules(
-                    "Trainer(mesh_axes=...)", mesh, self.config.mesh_axes,
+                    "Trainer", mesh, engine_spec,
                     user_rules=self.config.partition_rules,
-                    compress=self._compress,
+                    bind=engine_bind,
                 )
+            )
+        elif self._compress is not None:
+            raise ValueError(
+                "grad_compress rides the partition engine's quantized "
+                "wire, which needs a stateless model, grad_reduce='psum', "
+                "and no loss_scale — drop the conflicting option or use "
+                "mesh_axes engine mode explicitly"
             )
         if self.config.loss_scale is not None and not self.config.nan_guard:
             raise ValueError("loss_scale requires nan_guard=True")
         if self.config.nan_guard:
-            if self.config.loss_scale is not None and (
-                self.config.fsdp or self.config.zero1
-            ):
-                raise ValueError(
-                    "loss_scale is not threaded through the fsdp/zero1 "
-                    "step builders — use nan_guard without loss_scale "
-                    "there (skip-and-count still applies)"
-                )
             from tpu_dist.resilience.guards import nan_guard
 
             # Outermost wrapper: the step builder reads current_scale
@@ -209,31 +262,19 @@ class Trainer:
                     self.optimizer, init_scale=self.config.loss_scale
                 )
 
-        # torch.manual_seed(1234) analog: all replicas share this init key.
-        key = jax.random.key(self.config.seed)
-        params, state = model.init(key, in_shape)
-        sharded_mode = self._sharded_mode
-        if self.config.fsdp and self.config.zero1:
-            raise ValueError("fsdp and zero1 are mutually exclusive")
-        if sharded_mode and jax.tree.leaves(state):
+        # (params/state were initialized above — the reference's
+        # torch.manual_seed(1234) analog: all replicas share one key.)
+        if self._sharded_mode and not stateless:
             raise ValueError(
                 "TrainConfig.fsdp/zero1/mesh_axes support stateless models "
                 "only (no BatchNorm running stats); use "
-                "parallel.make_fsdp_train_step directly for custom state"
+                "parallel.make_partitioned_train_step directly for custom "
+                "state"
             )
-        if not sharded_mode:
+        if self._ruleset is None:
             self.params = parallel.replicate(params, mesh)
             self.model_state = parallel.replicate(state, mesh)
-            inner_opt = parallel.replicate(self.optimizer.init(params), mesh)
-            if self._wrap_ef:
-                # The error-feedback residual is per-rank train-step
-                # state riding the opt-state slot (checkpointed with it).
-                self.opt_state = compress_mod.wrap_opt_state(
-                    inner_opt, params, mesh.shape[parallel.DATA_AXIS],
-                    self._compress, mesh, parallel.DATA_AXIS,
-                )
-            else:
-                self.opt_state = inner_opt
+            self.opt_state = parallel.replicate(self.optimizer.init(params), mesh)
             # The step donates all three trees; any buffer shared between
             # them (e.g. an optimizer init that returns params leaves
             # uncopied — device_put maps equal inputs to ONE buffer) would be
@@ -278,7 +319,9 @@ class Trainer:
             # the loss is the GLOBAL computation (mean over the global
             # batch) and XLA derives the per-device program + every
             # collective from the rule-matched shardings; the same
-            # 5-tuple wrapper keeps fit() oblivious.
+            # 5-tuple wrapper keeps fit() oblivious.  grad_compress
+            # rides INSIDE the step as the bucketed quantized wire over
+            # the rule set's data axes (`comm.compress`).
             def engine_loss(p, batch, key):
                 x, y = batch
                 scores, _ = forward(p, state, x, key)
@@ -287,6 +330,7 @@ class Trainer:
             built = parallel.make_partitioned_train_step(
                 engine_loss, self.optimizer, mesh, params, self._ruleset,
                 accum_steps=self.config.accum_steps,
+                compress=self._compress,
             )
             self.params, self.opt_state = built.params, built.opt_state
             self.model_state = parallel.replicate(state, mesh)
@@ -300,59 +344,18 @@ class Trainer:
                 return p2, ms, o2, loss, aux
 
             self.step = engine_step
-        elif sharded_mode:
-            # ZeRO path: optimizer state (and, for fsdp, params) live
-            # permanently sharded; the step wrapper keeps the stateful
-            # 5-tuple contract so fit()/callers are oblivious to the
-            # sharding strategy.
-            def fsdp_loss(p, batch, key):
-                x, y = batch
-                scores, _ = forward(p, state, x, key)
-                return self._loss(scores, y), {}
-
-            make = (
-                parallel.make_fsdp_train_step
-                if self.config.fsdp
-                else parallel.make_zero1_train_step
-            )
-            fstep, p_sh, o_sh = make(
-                fsdp_loss, self.optimizer, mesh, params,
-                accum_steps=self.config.accum_steps,
-                grad_compress=self._compress,
-            )
-            # Same donation guard as the replicated path: the fsdp step
-            # donates both trees, so a buffer shared between them (e.g. an
-            # optimizer init returning param leaves uncopied) would be
-            # donated twice.
-            from tpu_dist.utils.debug import assert_no_aliasing
-
-            assert_no_aliasing(p_sh, o_sh)
-            self.params, self.opt_state = p_sh, o_sh
-            self.model_state = parallel.replicate(state, mesh)
-            self._param_template = jax.tree.map(
-                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params
-            )
-
-            def fsdp_step(p, ms, os_, batch, key):
-                p2, o2, loss, aux = fstep(p, os_, batch, key)
-                return p2, ms, o2, loss, aux
-
-            self.step = fsdp_step
         else:
-            self.step = parallel.make_stateful_train_step(
+            self.step = parallel.make_spmd_train_step(
                 loss_fn, self.optimizer, mesh,
                 accum_steps=self.config.accum_steps,
                 grad_reduce=self.config.grad_reduce,
-                grad_compress=self._compress,
             )
         # Wire accounting for telemetry (static per step): what the
         # compressed sync ships vs what exact fp32 would.
         self._compress_summary = None
         if self._compress is not None:
-            self._compress_summary = compress_mod.FlatPlan(
-                params, mesh.shape[parallel.DATA_AXIS], self._compress
-            ).wire_summary(
-                "reduce_scatter" if sharded_mode else "all_reduce"
+            self._compress_summary = self._partition.flat_plan.wire_summary(
+                "all_reduce"
             )
         self._eval_apply = jax.jit(
             lambda params, state, x: model.apply(params, state, x, train=False)[0]
@@ -671,14 +674,10 @@ class Trainer:
         # equal pieces), never below it.
         batch_size = max(self.world, min(batch_size, n) // self.world * self.world)
         eval_params = self.params
-        if self.config.fsdp:  # reassemble once for the whole eval pass
-            eval_params = parallel.fsdp_full_params(
-                self.params, self._param_template, self.mesh,
-                parallel.DATA_AXIS,  # the axis make_fsdp_train_step sharded over
-            )
-        elif self._ruleset is not None:
-            # engine mode: rule-sharded params all-gather once when any
-            # shard is non-addressable (identity on one process)
+        if self._ruleset is not None:
+            # engine mode (incl. the fsdp/zero1 flags): rule-sharded
+            # params all-gather once when any shard is non-addressable
+            # (identity on one process — jnp reads sharded arrays)
             eval_params = parallel.gather_replicated(self.params, self.mesh)
         # Eval batches ride the same prefetch pipeline as training: the
         # pad/stack assembly and H2D transfer for batch i+1 overlap the
