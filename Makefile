@@ -11,7 +11,7 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
         scaling multiproc longcontext train-lm train-lm-modes generate \
         chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
         bench-pipeline bench-decode bench-serve serve-demo bench-mesh \
-        analyze analyze-bless
+        analyze analyze-bless attribute attribute-smoke
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -21,6 +21,12 @@ analyze:  # static analyzer: lints + golden collective-plan gate (CI job)
 
 analyze-bless:  # regenerate the golden CollectivePlans under tests/goldens/
 	$(PY) -m tpu_dist.analysis --bless
+
+attribute:  # plan-vs-measured cost attribution (engine dp×fsdp int8 wire) + unbalanced-pipeline stage cost tables
+	$(PY) benchmarks/attribute.py --platform $(PLATFORM)
+
+attribute-smoke:  # CI gate: tiny program; report must validate, stage_costs.jsonl must row-parse
+	$(PY) benchmarks/attribute.py --smoke --platform $(PLATFORM)
 
 telemetry-demo:  # short traced training run; asserts the events file parses
 	cd demos && $(PY) telemetry_demo.py --platform $(PLATFORM) --world 4

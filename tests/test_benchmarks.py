@@ -242,3 +242,18 @@ def test_mesh_bench_compress_dimension():
     mesh_rows = [r for r in recs if r.get("metric") == "mesh_rule_set"]
     assert len(mesh_rows) >= 4
     assert all("provenance" in r for r in mesh_rows[-4:])
+
+
+def test_attribute_bench_smoke():
+    """make attribute-smoke mechanics: the report validates against the
+    blessed plan (or reports version skew), every class carries measured
+    time, and the headline JSON contract holds."""
+    out = run_bench(
+        "attribute.py", "--smoke", "--no-persist", "--platform", "cpu",
+    )
+    assert out["metric"] == "attribute"
+    assert out["programs"] == ["engine_dp"]
+    assert out["errors"] == []
+    assert out["golden"]["engine_dp"] in ("ok", "skew")
+    assert out["step_ms"]["engine_dp"] > 0
+    assert 0 <= out["compute_share"]["engine_dp"] <= 1

@@ -51,6 +51,40 @@ def test_watchdog_fire_emits_stall_event(tmp_path, monkeypatch, capsys):
     assert stalls[0]["ranks_behind"][0]["behind_s"] > 8.0
 
 
+def test_watchdog_fire_dumps_flight_recorder(tmp_path, monkeypatch, capsys):
+    """On fire the local flight-recorder ring is dumped and the stall
+    event carries the dump path — the warning points at forensic state
+    instead of being the only artifact."""
+    import json
+    import os
+
+    from tpu_dist.observe import events, flightrec
+
+    d = str(tmp_path / "telemetry")
+    monkeypatch.setenv(events.ENV_DIR, d)
+    monkeypatch.delenv(events.ENV_RANK, raising=False)
+    flightrec._reset_for_tests()
+    flightrec.get().record("step", step=11, phase="readback")
+    with utils.collective_watchdog(timeout_s=0.05, what="hang") as fired:
+        time.sleep(0.4)
+    assert fired.is_set()
+    capsys.readouterr()
+    stalls = [r for r in events.read_events(d) if r["event"] == "stall"]
+    assert len(stalls) == 1
+    dump_path = stalls[0]["flight_dump"]
+    assert dump_path and os.path.exists(dump_path)
+    doc = json.load(open(dump_path))
+    assert doc["reason"] == "watchdog:hang"
+    # the watchdog entry itself is on the ring: the last records name
+    # what the host was waiting on
+    kinds = [r["kind"] for r in doc["records"]]
+    assert "collective" in kinds
+    assert any(
+        r.get("step") == 11 for r in doc["records"] if r["kind"] == "step"
+    )
+    flightrec._reset_for_tests()
+
+
 def test_watchdog_explicit_dir_without_env(tmp_path, monkeypatch):
     """An explicit telemetry_dir must receive the stall event even when
     TPU_DIST_TELEMETRY is unset."""

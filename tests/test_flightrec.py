@@ -1,0 +1,476 @@
+"""Flight recorder: ring semantics, dump triggers, merge CLI, overhead.
+
+The chaos-kill integration test (slow/chaos-marked) is the acceptance
+story: a rank hard-killed mid-"training" leaves a dump, the supervisor
+gathers the gang's dumps on failure, and the merge CLI names the killed
+rank and its last completed step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from tpu_dist.observe import events as ev_mod
+from tpu_dist.observe import flightrec
+from tpu_dist.observe import spans as spans_mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder(monkeypatch):
+    """Each test gets its own singleton + clean env."""
+    monkeypatch.delenv(ev_mod.ENV_DIR, raising=False)
+    monkeypatch.delenv(ev_mod.ENV_RANK, raising=False)
+    monkeypatch.delenv(flightrec.ENV_DIR, raising=False)
+    monkeypatch.delenv(flightrec.ENV_CAPACITY, raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    flightrec._reset_for_tests()
+    yield
+    flightrec._reset_for_tests()
+
+
+def _fill(rec, steps, *, start=0):
+    for s in range(start, start + steps):
+        rec.record("step", step=s, phase="dispatch")
+        rec.record("step", step=s, phase="readback")
+
+
+class TestRing:
+    def test_capacity_bound(self):
+        rec = flightrec.FlightRecorder(capacity=8)
+        _fill(rec, 10)
+        assert len(rec) == 8
+        assert rec.total == 20
+        snap = rec.snapshot()
+        # oldest records dropped, newest kept
+        assert snap[-1] == {"t": snap[-1]["t"], "kind": "step",
+                            "step": 9, "phase": "readback"}
+        assert snap[0]["step"] >= 6
+
+    def test_env_capacity_and_off(self, monkeypatch):
+        monkeypatch.setenv(flightrec.ENV_CAPACITY, "16")
+        flightrec._reset_for_tests()
+        assert flightrec.get().capacity == 16
+        monkeypatch.setenv(flightrec.ENV_CAPACITY, "off")
+        flightrec._reset_for_tests()
+        rec = flightrec.get()
+        assert not rec.enabled
+        rec.record("step", step=1)  # no-op, never raises
+        assert rec.dump("x") is None
+
+    def test_dump_without_dir_is_none(self):
+        rec = flightrec.FlightRecorder()
+        rec.record("step", step=0, phase="readback")
+        assert rec.dump("manual") is None  # nowhere resolvable, no cwd litter
+
+    def test_dump_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ev_mod.ENV_RANK, "3")
+        monkeypatch.setenv("WORLD_SIZE", "4")
+        rec = flightrec.FlightRecorder(capacity=32)
+        _fill(rec, 4)
+        rec.record("mark", what="chaos_kill")
+        path = rec.dump("chaos_kill", dirpath=str(tmp_path))
+        assert path == str(tmp_path / "flightrec_rank3.json")
+        doc = json.loads(open(path).read())
+        assert doc["rank"] == 3 and doc["world"] == 4
+        assert doc["reason"] == "chaos_kill"
+        assert doc["records"][-1]["what"] == "chaos_kill"
+        assert flightrec.load_dump(path)["rank"] == 3
+
+    def test_record_overhead_is_cheap(self):
+        """The hot-path cost bound: one record must stay microseconds."""
+        rec = flightrec.FlightRecorder(capacity=512)
+        n = 20000
+        t0 = time.perf_counter()
+        for i in range(n):
+            rec.record("step", step=i, phase="dispatch")
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 100e-6, f"record() cost {per_call * 1e6:.1f}us"
+
+    def test_recorder_on_vs_off_step_delta_within_noise(self):
+        """Acceptance: recorder-on hot-path overhead is not measurable
+        above CPU-sim noise — a tiny jitted step loop with per-step ring
+        records stays within a generous factor of the bare loop (this is
+        the backstop against accidental I/O on the hot path, where the
+        ratio would explode)."""
+        import jax
+        import jax.numpy as jnp
+
+        step = jax.jit(lambda x: jnp.tanh(x @ x).sum())
+        x = jnp.ones((128, 128))
+        rec = flightrec.FlightRecorder(capacity=512)
+
+        def loop(record: bool, iters=60):
+            step(x).block_until_ready()  # compile outside the clock
+            t0 = time.perf_counter()
+            for i in range(iters):
+                if record:
+                    rec.record("step", step=i, phase="dispatch")
+                out = step(x)
+                if record:
+                    rec.record("step", step=i, phase="readback")
+            out.block_until_ready()
+            return time.perf_counter() - t0
+
+        off = min(loop(False) for _ in range(3))
+        on = min(loop(True) for _ in range(3))
+        assert on < off * 1.5 + 0.05, (on, off)
+
+
+class TestMerge:
+    def _gang(self, tmp_path, *, skew_rank1=0.0, world=None):
+        """Two dumped ranks: rank 0 completes 6 steps, rank 1 stops at 2."""
+        base = time.time()
+        for rank, steps in ((0, 6), (1, 3)):
+            rec = flightrec.FlightRecorder(capacity=64)
+            shift = skew_rank1 if rank == 1 else 0.0
+            for s in range(steps):
+                rec._buf.append(
+                    (base + s * 0.1 + shift, "step",
+                     {"step": s, "phase": "dispatch"})
+                )
+                rec._buf.append(
+                    (base + s * 0.1 + 0.01 + shift, "step",
+                     {"step": s, "phase": "readback"})
+                )
+            os.environ[ev_mod.ENV_RANK] = str(rank)
+            if world:
+                os.environ["WORLD_SIZE"] = str(world)
+            rec.dump("chaos_kill" if rank == 1 else "watchdog",
+                     dirpath=str(tmp_path))
+        os.environ[ev_mod.ENV_RANK] = "0"
+
+    def test_names_divergent_rank_and_last_step(self, tmp_path):
+        self._gang(tmp_path)
+        res = flightrec.merge(str(tmp_path))
+        assert res["n_dumps"] == 2
+        assert res["last_gang_step"] == 5
+        assert res["last_common_step"] == 2
+        assert res["divergent"][0]["rank"] == 1
+        assert res["divergent"][0]["last_completed_step"] == 2
+        text = flightrec.describe(res)
+        assert "DIVERGENT rank 1" in text
+        assert "last completed step 2" in text
+
+    def test_clock_alignment_corrects_skew(self, tmp_path):
+        # rank 1's wall clock is 100s ahead; matching step records must
+        # pull it back onto rank 0's timeline
+        self._gang(tmp_path, skew_rank1=100.0)
+        res = flightrec.merge(str(tmp_path))
+        off = res["ranks"][1]["clock_offset_s"]
+        assert abs(off + 100.0) < 1.0
+        # aligned timeline interleaves the ranks instead of clumping
+        # rank 1 a hundred seconds later
+        assert max(e["t_rel"] for e in res["timeline"]) < 10.0
+        assert res["divergent"][0]["rank"] == 1
+
+    def test_missing_rank_reported(self, tmp_path):
+        self._gang(tmp_path, world=3)
+        res = flightrec.merge(str(tmp_path))
+        assert res["missing"] == [2]
+        assert "NO DUMP" in flightrec.describe(res)
+
+    def test_empty_dir(self, tmp_path):
+        res = flightrec.merge(str(tmp_path))
+        assert res["n_dumps"] == 0
+        assert "no flight-recorder dumps" in flightrec.describe(res)
+
+    def test_cli_main(self, tmp_path, capsys):
+        self._gang(tmp_path)
+        rc = flightrec.main(["merge", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "DIVERGENT rank 1" in out
+        rc = flightrec.main(["merge", str(tmp_path), "--json"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert json.loads(out)["divergent"][0]["rank"] == 1
+
+    def test_cli_empty_dir_exits_nonzero(self, tmp_path, capsys):
+        assert flightrec.main(["merge", str(tmp_path)]) == 1
+        capsys.readouterr()
+
+    def test_scan_includes_gathered_attempt_dirs(self, tmp_path):
+        self._gang(tmp_path)
+        ranks, dest = flightrec.gather_dumps(str(tmp_path), attempt=0)
+        assert ranks == [0, 1]
+        assert dest == str(tmp_path / "flight" / "attempt0")
+        # root is clean, merge still finds the gathered dumps
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.startswith("flightrec_")]
+        res = flightrec.merge(str(tmp_path))
+        assert res["n_dumps"] == 2
+        assert res["divergent"][0]["rank"] == 1
+        # and merging the attempt dir directly works too
+        assert flightrec.merge(dest)["n_dumps"] == 2
+
+    def test_gather_empty_dir(self, tmp_path):
+        ranks, dest = flightrec.gather_dumps(str(tmp_path), attempt=0)
+        assert ranks == [] and dest is None
+
+    def test_merge_never_mixes_attempts(self, tmp_path):
+        """A relaunch restarts step counters: divergence must only be
+        computed within the newest incarnation's dumps, never across
+        attempt scopes (else healthy old-attempt ranks look behind)."""
+        # attempt 0: ranks 0+1 died early (gathered)
+        self._gang(tmp_path)
+        flightrec.gather_dumps(str(tmp_path), attempt=0)
+        # attempt 1 ran much further; only rank 1 dumped (at the root)
+        rec = flightrec.FlightRecorder(64)
+        for s in range(50):
+            rec.record("step", step=s, phase="readback")
+        os.environ[ev_mod.ENV_RANK] = "1"
+        rec.dump("exception", dirpath=str(tmp_path))
+        os.environ[ev_mod.ENV_RANK] = "0"
+        res = flightrec.merge(str(tmp_path))
+        # only the root (newest) scope is analyzed: one dump, no
+        # cross-attempt "rank 0 is 47 steps behind" misattribution
+        assert res["scope"] == "root"
+        assert res["n_dumps"] == 1
+        assert list(res["ranks"]) == [1]
+        assert res["divergent"] == []
+        # gathering the root dump moves analysis to the newest attempt
+        flightrec.gather_dumps(str(tmp_path), attempt=1)
+        res = flightrec.merge(str(tmp_path))
+        assert res["scope"] == "attempt1"
+        assert res["ranks"][1]["last_completed_step"] == 49
+        # scan_dumps still exposes everything for archival tooling
+        assert len(flightrec.scan_dumps(str(tmp_path))) == 3
+
+
+class TestCrashHooks:
+    def test_excepthook_dumps(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ev_mod.ENV_DIR, str(tmp_path))
+        rec = flightrec.get()
+        assert rec.enabled
+        rec.record("step", step=7, phase="readback")
+        # fire the (chained) excepthook by hand — raising for real would
+        # kill pytest
+        try:
+            raise RuntimeError("boom")
+        except RuntimeError:
+            sys.excepthook(*sys.exc_info())
+        path = tmp_path / "flightrec_rank0.json"
+        assert path.exists()
+        doc = json.loads(path.read_text())
+        assert doc["reason"] == "exception"
+        assert doc["records"][-1]["step"] == 7
+
+    def test_crash_dump_runs_callbacks(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ev_mod.ENV_DIR, str(tmp_path))
+        fired = []
+        cb = lambda: fired.append(1)  # noqa: E731
+        flightrec.register_crash_callback(cb)
+        try:
+            path = flightrec.crash_dump("manual")
+            assert path is not None and os.path.exists(path)
+            assert fired == [1]
+        finally:
+            # remove only OUR callback — other subsystems' registered
+            # crash hooks (e.g. the spans flush) must survive this test
+            flightrec._crash_callbacks.remove(cb)
+
+    def test_flightrec_dir_env_without_telemetry(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(flightrec.ENV_DIR, str(tmp_path))
+        rec = flightrec.get()
+        rec.record("mark", what="x")
+        path = rec.dump("manual")
+        assert path is not None and path.startswith(str(tmp_path))
+
+
+class TestSpansCrashSafety:
+    def test_flush_all_saves_without_explicit_save(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ev_mod.ENV_DIR, str(tmp_path))
+        rec = spans_mod.from_env()
+        with rec.span("work", step=1):
+            pass
+        assert not os.path.exists(rec.path)
+        spans_mod.flush_all()
+        doc = json.loads(open(rec.path).read())
+        assert doc["traceEvents"][0]["name"] == "work"
+
+    def test_crash_dump_flushes_spans(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ev_mod.ENV_DIR, str(tmp_path))
+        rec = spans_mod.from_env()  # registers the crash callback
+        with rec.span("doomed", step=2):
+            pass
+        flightrec.crash_dump("manual")
+        assert os.path.exists(rec.path)
+
+    def test_merge_traces_per_rank_lanes(self, tmp_path):
+        paths = []
+        for r in (0, 1):
+            rec = spans_mod.SpanRecorder(
+                str(tmp_path / f"spans_rank{r}.trace.json"), rank=r
+            )
+            with rec.span("step", step=r):
+                pass
+            paths.append(rec.save())
+        out = str(tmp_path / "merged.trace.json")
+        merged = spans_mod.merge_traces(paths, out_path=out)
+        names = {
+            (e.get("pid"), e.get("name"))
+            for e in merged["traceEvents"] if e.get("ph") == "M"
+        }
+        assert (0, "process_name") in names and (1, "process_name") in names
+        pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+        assert pids == {0, 1}
+        assert json.loads(open(out).read())["traceEvents"]
+
+
+class TestEventsSchema:
+    def test_flight_dump_event_validates(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ev_mod.ENV_DIR, str(tmp_path))
+        logger = ev_mod.EventLogger(str(tmp_path), 0)
+        logger.emit("flight_dump", reason="gang_failure", ranks=[0, 1],
+                    dir=str(tmp_path / "flight" / "attempt0"), attempt=0)
+        logger.close()
+        n, errors = ev_mod.validate_file(logger.path)
+        assert n == 1 and errors == []
+        # and a missing required key is an error
+        assert ev_mod.validate_record(
+            {"event": "flight_dump", "time": 0, "rank": 0, "run_id": "x",
+             "reason": "r"}
+        )
+
+    def test_attribution_event_required_keys(self):
+        errs = ev_mod.validate_record(
+            {"event": "attribution", "time": 0, "rank": 0, "run_id": "x",
+             "program": "p", "step_time": 0.1, "compute_seconds": 0.05,
+             "classes": []}
+        )
+        assert errs == []
+        assert any(
+            "classes" in e
+            for e in ev_mod.validate_record(
+                {"event": "attribution", "time": 0, "rank": 0,
+                 "run_id": "x", "program": "p", "step_time": 0.1,
+                 "compute_seconds": 0.05}
+            )
+        )
+
+
+class TestTrainerWiring:
+    def _telemetry(self, tmp_path, monkeypatch, **cfg):
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+
+        from tpu_dist.train import metrics as metrics_mod
+
+        monkeypatch.setenv(ev_mod.ENV_DIR, str(tmp_path))
+        flightrec._reset_for_tests()
+        mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("data",))
+        return metrics_mod.TrainTelemetry(
+            world=1, mesh=mesh, config={"x": 1}, trainer="T", **cfg
+        )
+
+    def test_step_records_land_in_ring(self, tmp_path, monkeypatch):
+        import jax.numpy as jnp
+
+        t = self._telemetry(tmp_path, monkeypatch)
+        step = lambda a: (a, None, None, jnp.float32(1.5), {})  # noqa: E731
+        t.run_step(step, (jnp.zeros(()),), epoch=0, batch_size=4)
+        kinds = [(r["kind"], r.get("phase")) for r in t.flight.snapshot()]
+        assert ("mark", None) in kinds  # fit_start
+        assert ("step", "dispatch") in kinds
+        assert ("step", "readback") in kinds
+        t.finish(ok=True)
+
+    def test_nan_streak_triggers_one_dump(self, tmp_path, monkeypatch):
+        t = self._telemetry(tmp_path, monkeypatch)
+        path = tmp_path / "flightrec_rank0.json"
+        for i, bad in enumerate([0, 1, 2, 3, 4, 5]):
+            t.step_done(
+                epoch=0, loss=1.0, step_seconds=0.01, batch_size=4,
+                nan_guard=True, bad=bad, scale=1.0,
+            )
+        assert path.exists()
+        doc = json.loads(path.read_text())
+        assert doc["reason"] == "nan_streak"
+        assert any(r.get("what") == "nan_streak" for r in doc["records"])
+        # one-shot: a later bad step doesn't re-dump
+        mtime = path.stat().st_mtime_ns
+        t.step_done(epoch=0, loss=1.0, step_seconds=0.01, batch_size=4,
+                    nan_guard=True, bad=6, scale=1.0)
+        assert path.stat().st_mtime_ns == mtime
+
+    def test_nan_streak_respects_sampling_stride(self, tmp_path, monkeypatch):
+        """With TPU_DIST_TELEMETRY_EVERY-style sampling, isolated bad
+        steps observed in successive windows are NOT a streak; a window
+        where every step went bad is."""
+        t = self._telemetry(tmp_path, monkeypatch)
+        path = tmp_path / "flightrec_rank0.json"
+        # four isolated bad steps, ten steps apart: no streak, no dump
+        for sid, bad in ((10, 1), (20, 2), (30, 3), (40, 4)):
+            t.step_done(epoch=0, loss=1.0, step_seconds=0.01, batch_size=4,
+                        nan_guard=True, step=sid, bad=bad, scale=1.0)
+        assert not path.exists()
+        # a fully-poisoned window: 10 bad in 10 steps -> streak, dump
+        t.step_done(epoch=0, loss=1.0, step_seconds=0.01, batch_size=4,
+                    nan_guard=True, step=50, bad=14, scale=1.0)
+        assert path.exists()
+        assert json.loads(path.read_text())["reason"] == "nan_streak"
+
+    def test_preempt_dumps(self, tmp_path, monkeypatch):
+        t = self._telemetry(tmp_path, monkeypatch)
+        t.preempted(signal="SIGTERM", epoch=1, step=3)
+        doc = json.loads((tmp_path / "flightrec_rank0.json").read_text())
+        assert doc["reason"] == "preempt:SIGTERM"
+
+
+# ---------------------------------------------------------- chaos gang kill
+
+
+def _flight_gang_worker(rank, world):
+    """A fake training loop recording into the flight ring; rank 1 is
+    chaos-hard-killed after step 2 through the same dump-then-_exit path
+    a launch-time kill clause takes."""
+    from tpu_dist.observe import flightrec as fr_mod
+    from tpu_dist.resilience import chaos as chaos_mod
+
+    fr = fr_mod.get()
+    for s in range(6):
+        fr.record("step", step=s, phase="dispatch")
+        fr.record("step", step=s, phase="readback")
+        if rank == 1 and s == 2:
+            chaos_mod.kill_with_dump("kill=1@step2")
+    # the healthy rank's watchdog-equivalent dump (in real incidents the
+    # watchdog or the supervisor-side exception path writes this)
+    fr.dump("watchdog:test")
+    return rank
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_kill_leaves_merged_dump_naming_killed_rank(tmp_path, monkeypatch):
+    """Acceptance: a chaos kill of one rank leaves per-rank flight dumps;
+    the supervisor gathers them + records a flight_dump event; the merge
+    CLI names the killed rank and its last completed step."""
+    from tpu_dist.comm import launch
+    from tpu_dist.resilience.retry import WorkerFailed
+
+    tdir = str(tmp_path / "telemetry")
+    monkeypatch.setenv(ev_mod.ENV_DIR, tdir)
+    with pytest.raises(WorkerFailed):
+        launch(_flight_gang_worker, 2, platform="cpu", timeout=240.0)
+    # supervisor gathered the dumps into the attempt dir + logged it
+    sup = os.path.join(tdir, "events_supervisor.jsonl")
+    recs = [json.loads(ln) for ln in open(sup) if ln.strip()]
+    fd = [r for r in recs if r["event"] == "flight_dump"]
+    assert fd and fd[0]["reason"] == "gang_failure"
+    assert 1 in fd[0]["ranks"]
+    assert os.path.isdir(fd[0]["dir"])
+    # the merge CLI names the killed rank and its last completed step
+    res = flightrec.merge(tdir)
+    assert res["divergent"][0]["rank"] == 1
+    assert res["divergent"][0]["last_completed_step"] == 2
+    assert res["last_gang_step"] == 5
+    text = flightrec.describe(res)
+    assert "DIVERGENT rank 1" in text and "last completed step 2" in text
+    killed = res["ranks"][1]
+    assert killed["reason"] == "chaos_kill"

@@ -27,9 +27,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_dist.observe import events as ev_mod  # noqa: E402
+from tpu_dist.observe import flightrec as fr_mod  # noqa: E402
 from tpu_dist.observe import heartbeat as hb_mod  # noqa: E402
 
-NOTABLE = ("retry", "chaos", "stall", "preempt", "checkpoint", "warning")
+NOTABLE = ("retry", "chaos", "stall", "preempt", "checkpoint", "warning",
+           "flight_dump")
 
 
 def _fmt(value, spec: str = "", none: str = "--") -> str:
@@ -90,6 +92,8 @@ def empty_state(dirpath: str) -> dict:
         "beats": {},
         "serve": None,     # last decode_step record (serving runs)
         "analysis": None,  # last static-analyzer summary (make analyze)
+        "attr": None,      # last attribution report (make attribute)
+        "flight": None,    # merged flight-recorder divergence, if dumps exist
     }
 
 
@@ -110,11 +114,34 @@ def update(state: dict, records: list) -> dict:
             state["serve"] = rec
         elif kind == "analysis":
             state["analysis"] = rec
+        elif kind == "attribution":
+            state["attr"] = rec
         if kind in NOTABLE:
             state["notable"].append(rec)
             del state["notable"][:-64]  # bounded; render shows the tail
     run_id = (state["manifest"] or {}).get("run_id")
     state["beats"] = hb_mod.read(state["dir"], run_id=run_id)
+    # Flight-recorder dumps under the dir mean something already went
+    # wrong: merge them and surface the straggler.  Dumps are immutable
+    # post-incident, so re-merge only when the (path, mtime) set changes
+    # — not on every 2s dashboard poll.
+    try:
+        sig = []
+        for path in fr_mod.scan_dumps(state["dir"]):
+            try:
+                sig.append((path, os.stat(path).st_mtime_ns))
+            except OSError:
+                continue
+        sig = tuple(sig)
+        if sig != state.get("_flight_sig"):
+            state["_flight_sig"] = sig
+            if sig:
+                merged = fr_mod.merge(state["dir"], limit=0)
+                state["flight"] = merged if merged["ranks"] else None
+            else:
+                state["flight"] = None
+    except Exception:
+        state["flight"] = None
     return state
 
 
@@ -202,6 +229,55 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
             f"  findings {f_s}"
             f"  goldens {an.get('golden') or '--'}"
             f"  ({_age(an.get('time'), now)})"
+        )
+
+    at = state.get("attr")
+    if at:
+        # plan-vs-measured attribution (make attribute): step time split
+        # into compute vs collectives, top classes by achieved wire GB/s
+        classes = at.get("classes") or []
+        top = sorted(
+            (c for c in classes if c.get("measured_s")),
+            key=lambda c: -c["measured_s"],
+        )[:3]
+        cls_s = "  ".join(
+            f"{c.get('kind')}@{'x'.join(c.get('axes') or ['?'])}"
+            f" {_fmt(c.get('measured_s', 0) * 1e3, '.2f')}ms"
+            f"/{_fmt(c.get('achieved_gbps'), '.2f')}GB/s"
+            for c in top
+        )
+        st = at.get("step_time")
+        comp = at.get("compute_seconds")
+        share = (
+            f" (compute {comp / st:.0%})" if st and comp is not None else ""
+        )
+        lines.append(
+            f"attr  {at.get('program')}"
+            f"  step {_fmt(st * 1e3 if st else None, '.2f')}ms{share}"
+            + (f"  {cls_s}" if cls_s else "")
+            + f"  golden {at.get('golden') or '--'}"
+            f"  ({_age(at.get('time'), now)})"
+        )
+
+    fl = state.get("flight")
+    if fl:
+        # flight-recorder dumps exist => something fired; name the
+        # straggler the merge identified
+        div = fl.get("divergent") or []
+        if div:
+            e = div[0]
+            who = (
+                f"DIVERGENT rank {e['rank']} (last step "
+                f"{e['last_completed_step']}; gang reached "
+                f"{fl.get('last_gang_step')})"
+            )
+        elif fl.get("missing"):
+            who = f"rank {fl['missing'][0]} has NO dump"
+        else:
+            who = f"all ranks at step {fl.get('last_gang_step')}"
+        lines.append(
+            f"flight  {fl.get('n_dumps')} dump(s)  {who}  "
+            f"(python -m tpu_dist.observe.flightrec merge {state['dir']})"
         )
 
     if state["epochs"]:

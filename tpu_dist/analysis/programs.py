@@ -311,3 +311,16 @@ def canonical_program(name: str) -> AnalysisProgram:
 def canonical_programs(names=None) -> dict[str, AnalysisProgram]:
     """The selected (default: all) canonical programs, cached."""
     return {n: canonical_program(n) for n in (names or CANONICAL)}
+
+
+def fresh_program(name: str) -> AnalysisProgram:
+    """Build an UNCACHED instance of a canonical program — for callers
+    that EXECUTE it (e.g. `observe.attribution` step timing): the engine
+    train steps donate their params/opt-state args, so running the
+    shared cached instance would consume buffers other consumers (the
+    golden gate, the lints) still hold."""
+    if name not in _BUILDERS:
+        raise ValueError(
+            f"unknown analysis program {name!r}; one of {list(_BUILDERS)}"
+        )
+    return _BUILDERS[name]()

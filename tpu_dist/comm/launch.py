@@ -71,6 +71,15 @@ def _child(fn, rank, world, addr, port, platform, conn, devices_per_proc,
         result = fn(rank, world)
         conn.send(("ok", pickle.dumps(result)))
     except BaseException as e:  # report child failures to the parent
+        # The exception is caught here, so the excepthook-based flight
+        # dump never fires — dump the ring explicitly: the crashing
+        # rank's last steps are exactly what the merge CLI needs.
+        try:
+            from tpu_dist.observe import flightrec as _flightrec
+
+            _flightrec.crash_dump(f"exception:{type(e).__name__}")
+        except Exception:
+            pass
         conn.send(("error", f"rank {rank}: {type(e).__name__}: {e}\n"
                    f"{traceback.format_exc()}"))
     finally:
@@ -137,6 +146,14 @@ def launch(
             return results
         except WorkerFailed as e:
             last_error = e
+            # Forensics before anything else: gather the per-rank flight
+            # dumps (chaos kills, crashed children, and watchdog fires
+            # all dump into the telemetry dir) into an attempt-scoped
+            # subdir so a relaunch's fresh dumps can't overwrite them,
+            # and record where they went.  `python -m
+            # tpu_dist.observe.flightrec merge <dir>` names the
+            # divergent rank from the gathered set.
+            _gather_flight_dumps(elog, attempt)
             elog.emit(
                 "retry", what="gang_relaunch", attempt=attempt + 1,
                 max_attempts=restarts + 1, error=str(e), world=world,
@@ -150,6 +167,31 @@ def launch(
             )
     assert last_error is not None
     raise last_error
+
+
+def _gather_flight_dumps(elog, attempt: int) -> None:
+    """Move per-rank flight-recorder dumps from the telemetry dir root
+    into ``flight/attempt<k>/`` and record a ``flight_dump`` event —
+    best-effort (a gang failure must surface even if the gather can't)."""
+    try:
+        from tpu_dist.observe import events as events_mod
+        from tpu_dist.observe import flightrec as flightrec_mod
+
+        # Same dir precedence the recorders dump under: children write
+        # to TPU_DIST_FLIGHTREC_DIR when telemetry is off, and those
+        # dumps must be attempt-scoped too or a relaunch overwrites them.
+        dirpath = (os.environ.get(events_mod.ENV_DIR)
+                   or os.environ.get(flightrec_mod.ENV_DIR))
+        if not dirpath:
+            return
+        ranks, dest = flightrec_mod.gather_dumps(dirpath, attempt)
+        if dest is not None:
+            elog.emit(
+                "flight_dump", reason="gang_failure", ranks=ranks,
+                dir=dest, attempt=attempt,
+            )
+    except Exception:
+        pass
 
 
 def _launch_once(

@@ -14,12 +14,18 @@ is the measurement substrate the ROADMAP's perf PRs cite:
                 correlated with `jax.profiler` device traces by step id
 - `heartbeat` — per-rank progress heartbeats, stall attribution
                 ("rank N is K seconds behind"), and goodput accounting
+- `flightrec` — always-on per-rank ring buffer of step/phase/collective
+                records, dumped on watchdog fire / signals / chaos kill /
+                crashes; ``python -m tpu_dist.observe.flightrec merge``
+                clock-aligns the dumps and names the divergent rank
 
 Everything here is stdlib-only and import-light: these modules are
 imported from bootstrap paths (`comm.launch._child`,
-`resilience.chaos`) that run before JAX backends initialize.
+`resilience.chaos`) that run before JAX backends initialize.  The one
+exception is `observe.attribution` (plan-vs-measured cost attribution —
+it EXECUTES compiled programs, so it needs jax); import it explicitly.
 """
 
-from tpu_dist.observe import events, heartbeat, registry, spans
+from tpu_dist.observe import events, flightrec, heartbeat, registry, spans
 
-__all__ = ["events", "heartbeat", "registry", "spans"]
+__all__ = ["events", "flightrec", "heartbeat", "registry", "spans"]

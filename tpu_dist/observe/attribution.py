@@ -1,0 +1,576 @@
+"""Plan-vs-measured cost attribution — joining the analyzer and the clock.
+
+The analyzer (`tpu_dist.analysis`) knows every collective a compiled
+program SHOULD run — kind, mesh axes, per-participant payload bytes —
+and telemetry knows how long each STEP took; neither can say which
+collective a slow step spent its time in, or what wire bandwidth the
+run actually achieved against the plan.  This module joins the two:
+
+- `attribute_program(program)` takes an `analysis.AnalysisProgram`
+  (engine / pipeline / serve — anything with a `CollectivePlan`),
+  measures the real step wall time, and measures each (kind, axes,
+  dtype) collective CLASS by replaying it on the same mesh with the
+  plan's exact per-participant payloads (a `shard_map` microprogram per
+  class).  The report buckets step time into compute vs each class and
+  computes achieved wire GB/s from the plan's payload bytes — so the
+  per-class BYTES in the report are the analyzer's numbers to the byte,
+  and the TIMES are measured, never estimated.
+- `measure_stage_costs` produces the measured per-pipeline-stage
+  forward/backward cost tables (`benchmarks/results/stage_costs.jsonl`)
+  that ROADMAP item 4's cost-weighted schedule generator consumes,
+  via the `parallel.pipeline.stage_cost_programs` hook.
+- `emit_report` publishes a report as the required ``attribution``
+  telemetry event plus Prometheus gauges
+  (``tpu_dist_attr_step_seconds``, ``tpu_dist_attr_collective_seconds``,
+  ``tpu_dist_attr_achieved_gbps``); `tools/tpu_top.py` renders the
+  latest event as the `attr` line.
+
+Methodology caveats (documented in docs/observability.md): replay
+timing includes one dispatch per class program, and CPU-sim collective
+times are memcpy numbers — treat achieved-GB/s as a regression guard
+on CPU and a bandwidth number only on real chips.  Unlike the rest of
+`tpu_dist.observe` this module NEEDS jax (it executes programs) and is
+therefore not imported by ``tpu_dist.observe.__init__``.
+
+``make attribute`` / ``make attribute-smoke`` drive this end to end
+(`benchmarks/attribute.py`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import asdict, dataclass, field
+
+REPORT_VERSION = 1
+
+# HLO element type -> a jnp dtype the replay collectives can carry.
+# ``pred`` rides int8 (same itemsize; psum of bool is not defined).
+_REPLAY_DTYPES = {
+    "f32": "float32", "f64": "float64", "f16": "float16",
+    "bf16": "bfloat16", "s8": "int8", "u8": "uint8", "pred": "int8",
+    "s16": "int16", "u16": "uint16", "s32": "int32", "u32": "uint32",
+    "s64": "int64", "u64": "uint64",
+}
+_ITEMSIZE_FALLBACK = {1: "int8", 2: "int16", 4: "int32", 8: "int64"}
+
+
+@dataclass
+class ClassCost:
+    """One (kind, axes, dtype) collective class of a program: the plan's
+    payload joined with its measured replay time."""
+
+    kind: str
+    axes: list | None
+    dtype: str
+    count: int
+    payload_bytes: int
+    max_elems: int
+    measured_s: float | None = None
+    achieved_gbps: float | None = None
+    share: float | None = None  # fraction of the measured step time
+
+    @property
+    def label(self) -> str:
+        axes = "x".join(self.axes) if self.axes else "?"
+        return f"{self.kind}:{axes}:{self.dtype}"
+
+
+@dataclass
+class AttributionReport:
+    """Plan-vs-measured attribution for one compiled program."""
+
+    program: str
+    mesh_axes: dict = field(default_factory=dict)
+    classes: list = field(default_factory=list)
+    step_time_s: float | None = None
+    collective_s: float | None = None
+    compute_s: float | None = None
+    iters: int = 0
+    golden: str | None = None   # golden-gate status when checked
+    version: int = REPORT_VERSION
+
+    def rows(self) -> list[dict]:
+        """The plan-comparable rows — same key/fields as
+        `analysis.plan.CollectivePlan.rows()`, so a report can be
+        checked byte-for-byte against a blessed golden."""
+        return [
+            {
+                "kind": c.kind,
+                "axes": list(c.axes) if c.axes is not None else None,
+                "dtype": c.dtype,
+                "count": c.count,
+                "bytes": c.payload_bytes,
+                "max_elems": c.max_elems,
+            }
+            for c in sorted(
+                self.classes,
+                key=lambda c: (c.kind, c.axes or ["~"], c.dtype),
+            )
+        ]
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AttributionReport":
+        classes = [ClassCost(**c) for c in d.get("classes", [])]
+        return cls(
+            program=d.get("program", ""),
+            mesh_axes=d.get("mesh_axes", {}),
+            classes=classes,
+            step_time_s=d.get("step_time_s"),
+            collective_s=d.get("collective_s"),
+            compute_s=d.get("compute_s"),
+            iters=d.get("iters", 0),
+            golden=d.get("golden"),
+            version=d.get("version", REPORT_VERSION),
+        )
+
+    def validate(self) -> list[str]:
+        """Structural errors (empty list = a well-formed report)."""
+        errors = []
+        if not self.program:
+            errors.append("report has no program name")
+        for c in self.classes:
+            if c.count <= 0:
+                errors.append(f"{c.label}: non-positive count {c.count}")
+            if c.payload_bytes < 0:
+                errors.append(f"{c.label}: negative payload bytes")
+            if c.measured_s is not None:
+                if c.measured_s <= 0:
+                    errors.append(
+                        f"{c.label}: non-positive measured time "
+                        f"{c.measured_s}"
+                    )
+                if c.payload_bytes > 0 and c.achieved_gbps is None:
+                    errors.append(f"{c.label}: measured but no achieved GB/s")
+        if self.step_time_s is not None and self.step_time_s <= 0:
+            errors.append(f"non-positive step time {self.step_time_s}")
+        if self.compute_s is not None and self.compute_s < 0:
+            errors.append(f"negative compute time {self.compute_s}")
+        return errors
+
+    def summary_lines(self) -> list[str]:
+        """Human rendering (the `make attribute` table)."""
+        lines = [
+            f"attribution: {self.program}  mesh "
+            + ",".join(f"{k}={v}" for k, v in self.mesh_axes.items())
+        ]
+        if self.step_time_s is not None:
+            comp = (
+                f"  compute {self.compute_s * 1e3:.2f}ms "
+                f"({self.compute_s / self.step_time_s:.0%})"
+                if self.compute_s is not None else ""
+            )
+            lines.append(
+                f"  step {self.step_time_s * 1e3:.2f}ms"
+                f"  collectives {(self.collective_s or 0) * 1e3:.2f}ms"
+                + comp
+            )
+        for c in sorted(
+            self.classes, key=lambda c: -(c.measured_s or 0.0)
+        ):
+            t = (
+                f"{c.measured_s * 1e3:8.3f}ms" if c.measured_s is not None
+                else "   (unmeasured)"
+            )
+            g = (
+                f"{c.achieved_gbps:8.3f} GB/s"
+                if c.achieved_gbps is not None else ""
+            )
+            share = f" {c.share:5.1%}" if c.share is not None else ""
+            lines.append(
+                f"  {c.label:<40} x{c.count:<3} "
+                f"{c.payload_bytes:>10,} B  {t}{share}  {g}"
+            )
+        return lines
+
+
+# ------------------------------------------------------------- measurement
+
+
+def _block(tree):
+    import jax
+
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return tree
+
+
+def _time_fn(fn, args: tuple, *, iters: int, warmup: int) -> float:
+    """Mean wall time per call, readback-closed."""
+    for _ in range(max(warmup, 1)):
+        _block(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(max(iters, 1)):
+        out = fn(*args)
+    _block(out)
+    return (time.perf_counter() - t0) / max(iters, 1)
+
+
+def _replay_dtype(name: str):
+    import jax.numpy as jnp
+
+    from tpu_dist.analysis import plan as plan_mod
+
+    key = _REPLAY_DTYPES.get(name)
+    if key is None:
+        key = _ITEMSIZE_FALLBACK.get(plan_mod.itemsize(name), "int32")
+    return jnp.dtype(key)
+
+
+def _class_replay(ops, axes, mesh, inner: int = 8):
+    """One jitted `shard_map` microprogram replaying every op of a
+    class: each operand becomes a flat per-participant array of the
+    op's exact payload (so bytes moved == the plan's bytes), the
+    collective runs over the class's mesh axes, and a scalar reduction
+    of every output keeps XLA from dropping any of them.
+
+    The whole pass repeats ``inner`` times inside ONE program (a
+    `fori_loop` whose carry perturbs every operand, so the collectives
+    are loop-variant and can't be hoisted): per-pass time is the wall
+    time over ``inner``, which amortizes the per-dispatch overhead that
+    would otherwise swamp small payloads.  Returns ``(fn, args,
+    inner)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    names = tuple(axes) if axes else tuple(str(n) for n in mesh.axis_names)
+    sizes = dict(zip((str(n) for n in mesh.axis_names),
+                     (int(s) for s in mesh.devices.shape)))
+    group = 1
+    for n in names:
+        group *= sizes.get(n, 1)
+    axis_arg = names if len(names) > 1 else names[0]
+
+    specs = []  # (kind, operand index) — static replay plan
+    arrays = []
+    for op in ops:
+        for dt, shape in zip(op.dtypes, op.shapes):
+            elems = 1
+            for d in shape:
+                elems *= int(d)
+            elems = max(elems, 1)
+            if op.kind == "all-to-all" and elems % group:
+                elems += group - elems % group  # pad to a splittable length
+            dtype = _replay_dtype(dt)
+            arrays.append(jnp.zeros((elems,), dtype))
+            specs.append((op.kind, len(arrays) - 1))
+
+    def one_pass(xs, carry):
+        acc = carry
+        for kind, i in specs:
+            # carry-dependent perturbation: keeps each iteration's
+            # collectives live inside the repeat loop
+            x = xs[i] + acc.astype(jnp.float32).astype(xs[i].dtype)
+            if kind in ("all-reduce", "reduce-scatter"):
+                # one reduce class: XLA lowers a logical reduce-scatter
+                # as all-reduce(+slice) on CPU anyway (analysis.plan)
+                y = lax.psum(x, axis_arg)
+            elif kind == "all-gather":
+                y = lax.all_gather(x, axis_arg)
+            elif kind == "all-to-all":
+                y = lax.all_to_all(
+                    x.reshape(group, -1), axis_arg,
+                    split_axis=0, concat_axis=0,
+                )
+            elif kind == "collective-permute":
+                k = sizes.get(names[0], 1)
+                perm = [(j, (j + 1) % k) for j in range(k)]
+                y = lax.ppermute(x, names[0], perm)
+            else:
+                y = x
+            # tiny but NONZERO weight: the sum must stay live (a 0.0
+            # weight would let XLA fold it away and drop the collective)
+            acc = acc + jnp.sum(y).astype(jnp.float32) * jnp.float32(1e-9)
+        return acc
+
+    def body(*xs):
+        return lax.fori_loop(
+            0, inner, lambda i, acc: one_pass(xs, acc) + jnp.float32(1.0),
+            jnp.float32(0.0),
+        )
+
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=tuple(P() for _ in arrays),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped), tuple(arrays), inner
+
+
+def _concrete_args(args) -> bool:
+    import jax
+
+    return all(
+        not isinstance(leaf, jax.ShapeDtypeStruct)
+        for leaf in jax.tree.leaves(args)
+    )
+
+
+def _time_step(program, *, iters: int, warmup: int) -> float | None:
+    """Measured wall time of the REAL program.  Engine train steps
+    donate (params, opt_state) — outputs are threaded back as inputs, so
+    pass a FRESH program (`analysis.programs.fresh_program`), never the
+    shared canonical cache, when measuring a donating step."""
+    if not _concrete_args(program.args):
+        return None
+    if program.built is not None:
+        p, o, *rest = program.args
+        for _ in range(max(warmup, 1)):
+            p, o, loss, _ = program.fn(p, o, *rest)
+        _block(loss)
+        t0 = time.perf_counter()
+        for _ in range(max(iters, 1)):
+            p, o, loss, _ = program.fn(p, o, *rest)
+        _block(loss)  # the p/o chain serializes the iterations
+        return (time.perf_counter() - t0) / max(iters, 1)
+    return _time_fn(program.fn, program.args, iters=iters, warmup=warmup)
+
+
+def attribute_program(
+    program,
+    *,
+    iters: int = 5,
+    warmup: int = 2,
+    measure_step: bool = True,
+) -> AttributionReport:
+    """The plan-vs-measured report for one `analysis.AnalysisProgram`.
+
+    Per-class payload bytes come straight from the program's
+    `CollectivePlan` (and therefore match the blessed golden when the
+    plan does); per-class times come from replaying the class on the
+    program's mesh; ``compute_s`` is the measured step time minus the
+    summed collective time (clamped at 0 — replay includes dispatch
+    overhead the fused program doesn't pay twice).
+
+    ``measure_step=False`` skips executing the real program (use for
+    cached/donating programs or ShapeDtypeStruct args); the per-class
+    replay measurement still runs whenever the program has a mesh."""
+    from tpu_dist.observe import flightrec
+
+    plan = program.plan
+    groups: dict[tuple, list] = {}
+    for c in plan.collectives:
+        groups.setdefault((c.kind, c.axes, c.dtype_key), []).append(c)
+    classes = []
+    for (kind, axes, dtype), ops in sorted(
+        groups.items(), key=lambda kv: (kv[0][0], kv[0][1] or ("~",),
+                                        kv[0][2])
+    ):
+        payload = sum(op.bytes for op in ops)
+        max_elems = max(op.max_elems for op in ops)
+        measured = gbps = None
+        if program.mesh is not None:
+            fn, args, inner = _class_replay(ops, axes, program.mesh)
+            flightrec.get().record(
+                "collective", what=f"replay:{kind}",
+                axes=list(axes) if axes else None, dtype=dtype,
+            )
+            measured = _time_fn(fn, args, iters=iters, warmup=warmup) / inner
+            if payload > 0 and measured > 0:
+                gbps = payload / measured / 1e9
+        classes.append(ClassCost(
+            kind=kind,
+            axes=list(axes) if axes is not None else None,
+            dtype=dtype,
+            count=len(ops),
+            payload_bytes=payload,
+            max_elems=max_elems,
+            measured_s=measured,
+            achieved_gbps=gbps,
+        ))
+    step_s = (
+        _time_step(program, iters=iters, warmup=warmup)
+        if measure_step else None
+    )
+    coll_s = (
+        sum(c.measured_s for c in classes if c.measured_s is not None)
+        if classes else 0.0
+    )
+    compute_s = None
+    if step_s is not None:
+        compute_s = max(step_s - (coll_s or 0.0), 0.0)
+        for c in classes:
+            if c.measured_s is not None and step_s > 0:
+                c.share = min(c.measured_s / step_s, 1.0)
+    return AttributionReport(
+        program=plan.name or getattr(program, "name", ""),
+        mesh_axes=dict(plan.mesh_axes),
+        classes=classes,
+        step_time_s=step_s,
+        collective_s=coll_s if classes else None,
+        compute_s=compute_s,
+        iters=iters,
+    )
+
+
+def check_against_golden(report: AttributionReport,
+                         goldens_dir: str) -> list[str]:
+    """Row-exact comparison of the report's per-class payload bytes /
+    counts against the program's blessed golden plan.  Sets
+    ``report.golden`` to ``ok`` / ``skew`` (different jax — counts are a
+    lowering artifact, compare waived) / ``missing`` / ``diff`` and
+    returns the row diffs."""
+    from tpu_dist.analysis import plan as plan_mod
+
+    golden = plan_mod.load_golden(goldens_dir, report.program)
+    if golden is None:
+        report.golden = "missing"
+        return [f"no blessed golden for {report.program!r}"]
+    if plan_mod.golden_version_skew(golden):
+        report.golden = "skew"
+        return []
+
+    def key(row):
+        axes = row["axes"]
+        return (row["kind"], tuple(axes) if axes is not None else None,
+                row["dtype"])
+
+    live = {key(r): r for r in report.rows()}
+    gold = {key(r): r for r in golden.get("rows", [])}
+    diffs = []
+    for k in sorted(set(gold) - set(live), key=repr):
+        diffs.append(f"class gone vs golden: {k}")
+    for k in sorted(set(live) - set(gold), key=repr):
+        diffs.append(f"class not in golden: {k}")
+    for k in sorted(set(live) & set(gold), key=repr):
+        # same fields the analyzer's own golden gate compares
+        # (plan.compare_to_golden): count, bytes, AND max_elems
+        for f in ("count", "bytes", "max_elems"):
+            if gold[k].get(f) is not None and live[k][f] != gold[k][f]:
+                diffs.append(
+                    f"{k}: {f} {gold[k][f]} (golden) != {live[k][f]} "
+                    f"(measured report)"
+                )
+    report.golden = "ok" if not diffs else "diff"
+    return diffs
+
+
+# ------------------------------------------------------- stage cost tables
+
+
+def measure_stage_costs(
+    stage_fns: list,
+    stage_params: list,
+    x0,
+    *,
+    iters: int = 5,
+    warmup: int = 2,
+    model: str = "pipeline",
+) -> list[dict]:
+    """Measured per-pipeline-stage forward/backward cost rows — the
+    tables ROADMAP item 4's cost-weighted schedule generator consumes.
+
+    ``stage_fns[s]`` is ``(params, x) -> y`` (the LAST stage returns the
+    scalar microbatch loss); stages may be heterogeneous — that is the
+    point: an embedding-heavy stage 0 and a vocab-head-heavy stage n−1
+    produce visibly unbalanced rows.  Uses the
+    `parallel.pipeline.stage_cost_programs` hook for the per-stage
+    jitted F/B programs, then times each with a readback-closed loop."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.parallel import pipeline as pipe_mod
+
+    progs, inputs, outputs = pipe_mod.stage_cost_programs(
+        stage_fns, stage_params, x0
+    )
+    rows = []
+    for s, pr in enumerate(progs):
+        p, x, y = stage_params[s], inputs[s], outputs[s]
+        fwd_s = _time_fn(pr["fwd"], (p, x), iters=iters, warmup=warmup)
+        g = jax.tree.map(jnp.ones_like, y)
+        bwd_s = _time_fn(pr["bwd"], (p, x, g), iters=iters, warmup=warmup)
+        rows.append({
+            "model": model,
+            "stage": s,
+            "n_stages": len(progs),
+            "fwd_s": fwd_s,
+            "bwd_s": bwd_s,
+            "params_bytes": int(sum(
+                leaf.size * leaf.dtype.itemsize
+                for leaf in jax.tree.leaves(p)
+            )),
+            "in_shape": list(getattr(x, "shape", ())),
+            "out_shape": list(getattr(y, "shape", ())),
+        })
+    return rows
+
+
+def persist_stage_costs(rows: list[dict], *, root: str | None = None) -> str:
+    """Append measured stage rows to
+    ``benchmarks/results/stage_costs.jsonl`` (one JSONL row per stage,
+    provenance-stamped via `bench.persist_event`)."""
+    import bench
+
+    path = None
+    for row in rows:
+        path = bench.persist_event(
+            {"metric": "stage_cost", **row},
+            root=root, out_name="stage_costs.jsonl",
+        )
+    return path
+
+
+# ------------------------------------------------------------- publication
+
+
+def emit_report(report: AttributionReport, *, events_logger=None,
+                registry=None) -> dict | None:
+    """Publish a report: the ``attribution`` telemetry event (required
+    schema — `observe.events`) plus the Prometheus attribution gauges.
+    Returns the emitted record (None when telemetry is off)."""
+    from tpu_dist.observe import events as ev_mod
+    from tpu_dist.observe import registry as reg_mod
+
+    reg = registry if registry is not None else reg_mod.REGISTRY
+    step_g = reg.gauge(
+        "tpu_dist_attr_step_seconds",
+        "attribution: measured program step wall time",
+    )
+    compute_g = reg.gauge(
+        "tpu_dist_attr_compute_seconds",
+        "attribution: step time not attributed to any collective class",
+    )
+    coll_g = reg.gauge(
+        "tpu_dist_attr_collective_seconds",
+        "attribution: measured replay time per collective class",
+    )
+    gbps_g = reg.gauge(
+        "tpu_dist_attr_achieved_gbps",
+        "attribution: achieved wire GB/s per collective class "
+        "(plan payload bytes / measured time)",
+    )
+    if report.step_time_s is not None:
+        step_g.set(report.step_time_s, program=report.program)
+    if report.compute_s is not None:
+        compute_g.set(report.compute_s, program=report.program)
+    for c in report.classes:
+        if c.measured_s is not None:
+            coll_g.set(c.measured_s, program=report.program, cls=c.label)
+        if c.achieved_gbps is not None:
+            gbps_g.set(c.achieved_gbps, program=report.program, cls=c.label)
+    logger = events_logger if events_logger is not None else ev_mod.from_env()
+    return logger.emit(
+        "attribution",
+        program=report.program,
+        step_time=report.step_time_s,
+        compute_seconds=report.compute_s,
+        collective_seconds=report.collective_s,
+        classes=[asdict(c) for c in report.classes],
+        mesh_axes=report.mesh_axes,
+        golden=report.golden,
+    )
+
+
+def save_report(report: AttributionReport, path: str) -> str:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(report.to_dict(), fh, indent=2, sort_keys=True)
+    return path

@@ -100,6 +100,16 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # analyze): programs analyzed, findings per lint rule, golden-plan
     # gate status ("ok" | "stale" | "missing" | "blessed" | null)
     "analysis": ("programs", "findings", "golden"),
+    # flight-recorder dumps gathered (observe.flightrec): the comm.launch
+    # supervisor on gang failure/relaunch ("gang_failure"), or any local
+    # dump trigger that records one; `dir` is where the per-rank
+    # flightrec_rank<r>.json files landed, `ranks` which ranks dumped
+    "flight_dump": ("reason", "ranks", "dir"),
+    # plan-vs-measured cost attribution (observe.attribution / make
+    # attribute): measured step time bucketed into compute vs each
+    # (kind, axes, dtype) collective class, with plan payload bytes and
+    # achieved wire GB/s per class
+    "attribution": ("program", "step_time", "compute_seconds", "classes"),
 }
 
 

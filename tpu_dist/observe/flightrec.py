@@ -1,0 +1,621 @@
+"""Collective flight recorder — the NCCL-flight-recorder shape, for XLA.
+
+When a gang hangs or a rank dies, the question is always the same: which
+rank desynced, at which step, doing what?  Until now the answer was one
+watchdog warning line on stderr and nothing durable.  This module keeps a
+cheap ALWAYS-ON per-rank ring buffer (a fixed-size `collections.deque` —
+no I/O, no locks on the hot path) of the last N step / phase /
+collective / heartbeat records, and dumps it to
+``flightrec_rank<r>.json`` when something goes wrong:
+
+- `utils.debug.collective_watchdog` fire (the dump path rides the
+  ``stall`` event),
+- SIGTERM / SIGINT and unhandled exceptions (chained handlers installed
+  by `get` when a dump directory is resolvable),
+- `resilience.chaos` kill clauses (the injected hard-exit dumps first),
+- NaN-guard poison streaks (`train.metrics.TrainTelemetry`),
+- trainer preemption (`TrainTelemetry.preempted`).
+
+The `comm.launch` gang supervisor gathers the per-rank dumps into
+``<telemetry-dir>/flight/attempt<k>/`` on every gang failure/relaunch
+and records a ``flight_dump`` event.  The merge CLI
+
+    python -m tpu_dist.observe.flightrec merge <dir>
+
+clock-aligns the per-rank dumps (matching step records estimate each
+rank's wall-clock offset against a reference rank), renders a unified
+timeline, and names the divergent rank and the last step the whole gang
+completed.  Stdlib-only, like the rest of `tpu_dist.observe` — the CLI
+runs on a login host with no JAX installed.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import statistics
+import sys
+import threading
+import time
+
+from tpu_dist.observe import events as _events
+
+ENV_CAPACITY = "TPU_DIST_FLIGHTREC"      # ring size; "0"/"off" disables
+ENV_DIR = "TPU_DIST_FLIGHTREC_DIR"       # dump dir when telemetry is off
+DEFAULT_CAPACITY = 512
+
+# Record kinds (free-form strings; these are the conventional ones):
+#   step        — one training/serve step boundary ({step, phase, ...})
+#   phase       — a host phase transition (checkpoint, eval, drain)
+#   collective  — a device program / collective the host is waiting on
+#   heartbeat   — a heartbeat file write went through
+#   mark        — one-shot annotations (fit_start, preempt, chaos_kill)
+
+
+def dump_path_for(dirpath: str, rank: int) -> str:
+    return os.path.join(dirpath, f"flightrec_rank{rank}.json")
+
+
+class FlightRecorder:
+    """Fixed-size in-memory ring of (wall-time, kind, fields) records.
+
+    ``record`` is the hot-path call: one deque append (the GIL makes it
+    atomic — no lock), a dict allocation, one ``time.time()``.  All I/O
+    happens in `dump`, which is only called when something already went
+    wrong."""
+
+    enabled = True
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = int(capacity)
+        self._buf: collections.deque = collections.deque(maxlen=self.capacity)
+        self.total = 0  # lifetime records (ring overwrites don't decrement)
+
+    def record(self, kind: str, **fields) -> None:
+        self.total += 1
+        self._buf.append((time.time(), kind, fields))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def snapshot(self) -> list[dict]:
+        return [
+            {"t": t, "kind": kind, **fields}
+            for t, kind, fields in list(self._buf)
+        ]
+
+    def resolve_dir(self, dirpath: str | None = None) -> str | None:
+        """Where a dump would land: explicit > ``TPU_DIST_TELEMETRY`` >
+        ``TPU_DIST_FLIGHTREC_DIR`` > nowhere (None — no unsolicited
+        files in the cwd)."""
+        return (
+            dirpath
+            or os.environ.get(_events.ENV_DIR)
+            or os.environ.get(ENV_DIR)
+            or None
+        )
+
+    def dump(self, reason: str = "manual", *,
+             dirpath: str | None = None) -> str | None:
+        """Write the ring to ``flightrec_rank<r>.json`` (atomic rename;
+        newest dump per rank wins — it holds the longest history).
+        Returns the path, or None when no dump directory is resolvable.
+        Never raises: the dump runs on crash paths."""
+        try:
+            dirpath = self.resolve_dir(dirpath)
+            if dirpath is None:
+                return None
+            rank = _events.env_rank()
+            os.makedirs(dirpath, exist_ok=True)
+            path = dump_path_for(dirpath, rank)
+            world = None
+            try:
+                world = int(os.environ.get("WORLD_SIZE", ""))
+            except ValueError:
+                pass
+            doc = {
+                "rank": rank,
+                "world": world,
+                "pid": os.getpid(),
+                "run_id": os.environ.get(_events.ENV_RUN_ID),
+                "reason": reason,
+                "dumped_at": time.time(),
+                "capacity": self.capacity,
+                "total_records": self.total,
+                "records": self.snapshot(),
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, default=_events._json_default)
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
+
+
+class NullFlightRecorder:
+    """``TPU_DIST_FLIGHTREC=off`` stand-in: same surface, zero cost."""
+
+    enabled = False
+    capacity = 0
+    total = 0
+
+    def record(self, kind: str, **fields) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return 0
+
+    def snapshot(self) -> list:
+        return []
+
+    def resolve_dir(self, dirpath=None):
+        return None
+
+    def dump(self, reason: str = "manual", *, dirpath=None):
+        return None
+
+
+NULL = NullFlightRecorder()
+_recorder = None
+_lock = threading.Lock()
+_crash_callbacks: list = []
+_excepthook_installed = False
+_signals_installed = False
+
+
+def _capacity_from_env() -> int:
+    raw = (os.environ.get(ENV_CAPACITY) or "").strip().lower()
+    if raw in ("0", "off", "false"):
+        return 0
+    try:
+        return max(int(raw), 1)
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+def get():
+    """The process's flight recorder (created on first use; ring always
+    on unless ``TPU_DIST_FLIGHTREC`` disables it).  Creation installs
+    the crash hooks when a dump directory is resolvable — with nowhere
+    to dump, the process's signal/excepthook state is left alone.
+
+    Steady state is LOCK-FREE (double-checked read of the singleton):
+    `crash_dump` runs inside signal handlers, and a handler landing
+    while this thread already held a non-reentrant lock would deadlock
+    the dying process instead of dumping."""
+    rec = _recorder
+    if rec is None:
+        with _lock:
+            rec = _recorder
+            if rec is None:
+                cap = _capacity_from_env()
+                rec = FlightRecorder(cap) if cap else NULL
+                _set_recorder(rec)
+    if rec.enabled and rec.resolve_dir() is not None:
+        install_hooks()
+    return rec
+
+
+def _set_recorder(rec) -> None:
+    global _recorder
+    _recorder = rec
+
+
+def _reset_for_tests() -> None:
+    """Drop the singleton so the next `get` re-reads the environment
+    (crash hooks, once installed, stay installed — they chain)."""
+    global _recorder
+    with _lock:
+        _recorder = None
+
+
+def register_crash_callback(fn) -> None:
+    """Run ``fn()`` on every crash dump (watchdog / signal / exception /
+    chaos kill) — `observe.spans` registers its trace flush here so
+    Chrome traces survive crashes too.  Callbacks must not raise (they
+    are wrapped anyway)."""
+    if fn not in _crash_callbacks:
+        _crash_callbacks.append(fn)
+
+
+def crash_dump(reason: str, *, dirpath: str | None = None) -> str | None:
+    """Dump the ring AND run the registered crash callbacks (span trace
+    flush, ...).  The one entry point every dump trigger calls."""
+    path = get().dump(reason, dirpath=dirpath)
+    for cb in list(_crash_callbacks):
+        try:
+            cb()
+        except Exception:
+            pass
+    return path
+
+
+def install_hooks() -> None:
+    """Chain the unhandled-exception hook and SIGTERM/SIGINT handlers to
+    `crash_dump` (previous behavior preserved — handlers are chained,
+    never replaced outright).  Idempotent PER PART: signal handlers can
+    only install from the main thread, so a first call from a worker
+    thread (a watchdog, a server thread) must not latch them out — the
+    signal half retries on the next main-thread call."""
+    global _excepthook_installed, _signals_installed
+    if not _excepthook_installed:
+        _excepthook_installed = True
+        prev_hook = sys.excepthook
+
+        def _excepthook(tp, val, tb):
+            crash_dump("exception")
+            prev_hook(tp, val, tb)
+
+        sys.excepthook = _excepthook
+
+    if (_signals_installed
+            or threading.current_thread() is not threading.main_thread()):
+        return
+    _signals_installed = True
+    for signum, name in ((signal.SIGTERM, "sigterm"),
+                         (signal.SIGINT, "sigint")):
+        try:
+            prev = signal.getsignal(signum)
+
+            def _handler(sig, frame, prev=prev, name=name):
+                crash_dump(name)
+                if callable(prev):
+                    prev(sig, frame)
+                else:
+                    # SIG_DFL / SIG_IGN: restore and re-deliver so the
+                    # process dies the way it would have without us.
+                    signal.signal(sig, prev if prev is not None
+                                  else signal.SIG_DFL)
+                    os.kill(os.getpid(), sig)
+
+            signal.signal(signum, _handler)
+        except (ValueError, OSError):
+            pass  # non-main thread race / exotic platform
+
+
+# -------------------------------------------------- dump discovery / merge
+
+
+def _dump_files_in(dirpath: str) -> list[str]:
+    try:
+        return [
+            os.path.join(dirpath, n)
+            for n in sorted(os.listdir(dirpath))
+            if n.startswith("flightrec_rank") and n.endswith(".json")
+        ]
+    except OSError:
+        return []
+
+
+def scan_dump_scopes(dirpath: str) -> list[tuple[str, list[str]]]:
+    """Flight dumps under ``dirpath``, grouped by INCARNATION: the dir
+    root (the current/ungathered attempt) plus each of the supervisor's
+    ``flight/attempt<k>/`` gather dirs, newest scope first.  Dumps from
+    different attempts must never be compared against each other — a
+    relaunch's step counters restart, so mixing scopes would blame the
+    wrong rank."""
+    scopes: list[tuple[str, list[str]]] = []
+    root = _dump_files_in(dirpath)
+    if root:
+        scopes.append(("root", root))
+    flight = os.path.join(dirpath, "flight")
+    attempts = []
+    try:
+        for name in os.listdir(flight):
+            if name.startswith("attempt"):
+                try:
+                    attempts.append((int(name[len("attempt"):]), name))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    for _, name in sorted(attempts, reverse=True):
+        files = _dump_files_in(os.path.join(flight, name))
+        if files:
+            scopes.append((name, files))
+    return scopes
+
+
+def scan_dumps(dirpath: str) -> list[str]:
+    """Every flight dump under ``dirpath`` across all scopes (root plus
+    gathered attempts).  For divergence analysis use `merge`, which
+    restricts itself to the NEWEST scope."""
+    return [p for _, files in scan_dump_scopes(dirpath) for p in files]
+
+
+def load_dump(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict) or "records" not in doc:
+        return None
+    doc["path"] = path
+    return doc
+
+
+def _newest_per_rank(dumps: list[dict]) -> dict[int, dict]:
+    by_rank: dict[int, dict] = {}
+    for d in dumps:
+        r = int(d.get("rank", 0))
+        cur = by_rank.get(r)
+        if cur is None or d.get("dumped_at", 0) > cur.get("dumped_at", 0):
+            by_rank[r] = d
+    return by_rank
+
+
+def _step_times(dump: dict) -> dict:
+    """(step, phase) -> wall time, for clock alignment."""
+    out = {}
+    for rec in dump.get("records", []):
+        if rec.get("kind") == "step" and rec.get("step") is not None:
+            out[(rec["step"], rec.get("phase"))] = rec["t"]
+    return out
+
+
+def clock_offsets(by_rank: dict[int, dict]) -> dict[int, float]:
+    """Per-rank wall-clock offset onto the reference rank (the lowest
+    rank with step records): the median difference of same-(step, phase)
+    record times.  Ranks with no overlap get offset 0 — on one host the
+    wall clocks already agree; across hosts this is the skew estimate."""
+    ranks = sorted(by_rank)
+    ref = next(
+        (r for r in ranks if _step_times(by_rank[r])), ranks[0] if ranks else 0
+    )
+    ref_times = _step_times(by_rank.get(ref, {}))
+    offsets = {}
+    for r in ranks:
+        if r == ref:
+            offsets[r] = 0.0
+            continue
+        times = _step_times(by_rank[r])
+        deltas = [
+            ref_times[k] - times[k] for k in times if k in ref_times
+        ]
+        offsets[r] = statistics.median(deltas) if deltas else 0.0
+    return offsets
+
+
+def _last_completed_step(dump: dict) -> int | None:
+    """The last step this rank finished: the max ``step`` record with
+    phase ``readback`` (a dispatched-but-unread step does not count)."""
+    best = None
+    for rec in dump.get("records", []):
+        if (rec.get("kind") == "step" and rec.get("phase") == "readback"
+                and rec.get("step") is not None):
+            s = int(rec["step"])
+            best = s if best is None else max(best, s)
+    return best
+
+
+def merge(dirpath: str, *, limit: int = 40) -> dict:
+    """Clock-align every rank's newest dump of the NEWEST incarnation
+    under ``dirpath`` (the root scope when ungathered dumps exist, else
+    the highest ``flight/attempt<k>/`` — attempts restart their step
+    counters, so cross-attempt comparison would blame the wrong rank)
+    and reduce them to the incident story: per-rank last-completed
+    steps, the divergent rank(s), missing ranks, a unified timeline.
+
+    Returns a JSON-able dict; `describe` renders it for humans."""
+    scopes = scan_dump_scopes(dirpath)
+    scope, paths = scopes[0] if scopes else (None, [])
+    dumps = [d for d in (load_dump(p) for p in paths) if d is not None]
+    by_rank = _newest_per_rank(dumps)
+    if not by_rank:
+        return {"dir": dirpath, "scope": scope, "n_dumps": 0, "ranks": {},
+                "divergent": [], "missing": [], "last_common_step": None,
+                "last_gang_step": None, "timeline": []}
+    offsets = clock_offsets(by_rank)
+    ranks: dict[int, dict] = {}
+    timeline = []
+    t_min = None
+    for r, d in sorted(by_rank.items()):
+        off = offsets.get(r, 0.0)
+        recs = d.get("records", [])
+        last = recs[-1] if recs else None
+        last_step = _last_completed_step(d)
+        ranks[r] = {
+            "path": d.get("path"),
+            "reason": d.get("reason"),
+            "run_id": d.get("run_id"),
+            "n_records": len(recs),
+            "last_completed_step": last_step,
+            "last_record": last,
+            "clock_offset_s": round(off, 6),
+        }
+        for rec in recs:
+            t = rec.get("t", 0.0) + off
+            t_min = t if t_min is None else min(t_min, t)
+            timeline.append((t, r, rec))
+    timeline.sort(key=lambda e: e[0])
+    steps = [v["last_completed_step"] for v in ranks.values()]
+    known = [s for s in steps if s is not None]
+    last_gang = max(known) if known else None
+    last_common = min(known) if known and len(known) == len(steps) else None
+    # Divergent = behind the furthest rank (or recorded nothing while
+    # others progressed), most-behind first.
+    divergent = []
+    if last_gang is not None:
+        for r, v in ranks.items():
+            s = v["last_completed_step"]
+            if s is None or s < last_gang:
+                divergent.append({
+                    "rank": r,
+                    "last_completed_step": s,
+                    "behind_steps": (last_gang - s) if s is not None else None,
+                    "reason": v["reason"],
+                })
+        divergent.sort(
+            key=lambda e: (e["behind_steps"] is None,
+                           -(e["behind_steps"] or 0), e["rank"])
+        )
+    worlds = [d.get("world") for d in by_rank.values() if d.get("world")]
+    missing = []
+    if worlds:
+        missing = [r for r in range(max(worlds)) if r not in ranks]
+    return {
+        "dir": dirpath,
+        "scope": scope,
+        "n_dumps": len(dumps),
+        "ranks": ranks,
+        "divergent": divergent,
+        "missing": missing,
+        "last_common_step": last_common,
+        "last_gang_step": last_gang,
+        "timeline": [
+            {
+                "t_rel": round(t - (t_min or 0.0), 6), "rank": r,
+                **{k: v for k, v in rec.items() if k != "t"},
+            }
+            for t, r, rec in (timeline[-limit:] if limit > 0 else [])
+        ],
+    }
+
+
+def describe(result: dict, *, timeline: int = 20) -> str:
+    """The operator-facing rendering of a `merge` result."""
+    lines = []
+    if not result["ranks"]:
+        return f"no flight-recorder dumps under {result['dir']}"
+    scope = result.get("scope")
+    lines.append(
+        f"flight merge: {result['n_dumps']} dump(s), "
+        f"{len(result['ranks'])} rank(s) under {result['dir']}"
+        + (f" (scope {scope})" if scope and scope != "root" else "")
+    )
+    for r in sorted(result["ranks"]):
+        v = result["ranks"][r]
+        last = v["last_record"] or {}
+        what = last.get("kind", "--")
+        if last.get("step") is not None:
+            what += f" step={last['step']}"
+        if last.get("phase"):
+            what += f" phase={last['phase']}"
+        lines.append(
+            f"  rank {r}: {v['n_records']} records, last completed step "
+            f"{v['last_completed_step']}, last record [{what}], "
+            f"dump reason {v['reason']!r}"
+        )
+    for r in result["missing"]:
+        lines.append(f"  rank {r}: NO DUMP (dead before recording, or "
+                     f"never launched)")
+    if result["last_gang_step"] is not None:
+        lines.append(
+            f"last step completed by the furthest rank: "
+            f"{result['last_gang_step']}"
+            + (f"; by every dumped rank: {result['last_common_step']}"
+               if result["last_common_step"] is not None else "")
+        )
+    if result["divergent"]:
+        e = result["divergent"][0]
+        where = (
+            f"last completed step {e['last_completed_step']}"
+            if e["last_completed_step"] is not None
+            else "no completed step on record"
+        )
+        lines.append(
+            f"DIVERGENT rank {e['rank']}: {where} "
+            f"(gang reached {result['last_gang_step']})"
+        )
+        for e in result["divergent"][1:]:
+            lines.append(
+                f"  also behind: rank {e['rank']} "
+                f"(last completed step {e['last_completed_step']})"
+            )
+    elif result["missing"]:
+        lines.append(
+            f"DIVERGENT rank {result['missing'][0]}: no dump at all"
+        )
+    else:
+        lines.append("no divergence: every rank reached the same step")
+    tail = result["timeline"][-timeline:]
+    if tail:
+        lines.append(f"timeline (last {len(tail)} records, clock-aligned):")
+        for rec in tail:
+            extra = {
+                k: v for k, v in rec.items()
+                if k not in ("t_rel", "rank", "kind")
+            }
+            body = "  ".join(f"{k}={v}" for k, v in extra.items())
+            lines.append(
+                f"  +{rec['t_rel']:9.3f}s rank {rec['rank']} "
+                f"{rec['kind']:<10} {body[:100]}"
+            )
+    return "\n".join(lines)
+
+
+# -------------------------------------------------- supervisor gather
+
+
+def gather_dumps(dirpath: str, attempt: int = 0) -> tuple[list[int], str | None]:
+    """Move the per-rank dumps at ``dirpath``'s root into
+    ``flight/attempt<k>/`` — the `comm.launch` supervisor calls this on
+    every gang failure so a relaunch's fresh dumps can't overwrite the
+    forensic state of the attempt that died.  Returns (ranks moved,
+    destination dir or None when there was nothing to gather)."""
+    ranks = []
+    dest = os.path.join(dirpath, "flight", f"attempt{attempt}")
+    for path in _dump_files_in(dirpath):
+        doc = load_dump(path)
+        if doc is None:
+            continue
+        try:
+            os.makedirs(dest, exist_ok=True)
+            os.replace(path, os.path.join(dest, os.path.basename(path)))
+            ranks.append(int(doc.get("rank", 0)))
+        except OSError:
+            continue
+    return sorted(ranks), (dest if ranks else None)
+
+
+# ---------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.observe.flightrec",
+        description="merge + analyze per-rank flight-recorder dumps",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("merge", help="clock-align dumps, name the "
+                        "divergent rank and last completed step")
+    mp.add_argument("dir", help="telemetry dir (or a flight/attemptN dir)")
+    mp.add_argument("--json", action="store_true",
+                    help="machine-readable merge result")
+    mp.add_argument("--limit", type=int, default=40,
+                    help="timeline records to keep")
+    args = ap.parse_args(argv)
+
+    result = merge(args.dir, limit=args.limit)
+    if args.json:
+        print(json.dumps(result, indent=2, default=str))
+    else:
+        print(describe(result))
+    # Span traces alongside the dumps merge into one perfetto file with
+    # per-rank process lanes (observe.spans.merge_traces).
+    try:
+        from tpu_dist.observe import spans as spans_mod
+
+        trace_paths = [
+            os.path.join(args.dir, n)
+            for n in sorted(os.listdir(args.dir))
+            if n.startswith("spans_rank") and n.endswith(".trace.json")
+        ]
+        if trace_paths:
+            out = os.path.join(args.dir, "spans_merged.trace.json")
+            spans_mod.merge_traces(trace_paths, out_path=out)
+            print(f"merged {len(trace_paths)} span trace(s) -> {out}",
+                  file=sys.stderr)
+    except Exception:
+        pass
+    return 0 if result["ranks"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,12 @@ class HeartbeatWriter:
             return  # a full disk must not kill the training loop
         self._last_write = now
         self._last_state = state
+        # Every beat that reached disk also lands in the flight ring, so
+        # a post-mortem dump shows the progress cadence alongside the
+        # step/phase records (one deque append — no extra I/O).
+        from tpu_dist.observe import flightrec as _flightrec
+
+        _flightrec.get().record("heartbeat", step=step, phase=phase)
 
     def close(self, phase: str = "done") -> None:
         step = self._last_state[0] if self._last_state else None

@@ -19,6 +19,7 @@ call at fit-exit).  Stdlib-only.
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
 import os
@@ -148,6 +149,45 @@ class NullRecorder:
 NULL = NullRecorder()
 _cache: dict[tuple[str, int], SpanRecorder] = {}
 _cache_lock = threading.Lock()
+_flush_installed = False
+
+
+def flush_all() -> None:
+    """Save every cached recorder.  Best-effort and LOCK-FREE: this runs
+    inside signal handlers (a flight-recorder crash callback), where
+    acquiring ``_cache_lock`` could deadlock against the interrupted
+    thread already holding it — a racing ``from_env`` insert at worst
+    costs this flush one recorder, not the process."""
+    try:
+        recs = list(_cache.values())
+    except RuntimeError:  # dict mutated mid-iteration by a live insert
+        recs = []
+    for rec in recs:
+        try:
+            rec.save()
+        except Exception:
+            pass
+
+
+def _install_flush_hooks() -> None:
+    """`save` is otherwise only called at fit-exit, so a crash between
+    fits (or mid-fit before the finally) would lose the whole trace:
+    register the flush at interpreter exit AND on the flight recorder's
+    crash paths (watchdog fire, SIGTERM/SIGINT, unhandled exception,
+    chaos kill) so Chrome traces survive crashes."""
+    global _flush_installed
+    if not _flush_installed:
+        _flush_installed = True
+        atexit.register(flush_all)
+    # (Re-)register with the flight recorder on every new recorder:
+    # registration de-dupes, and this heals the hook if someone reset
+    # the crash-callback list.
+    try:
+        from tpu_dist.observe import flightrec as _flightrec
+
+        _flightrec.register_crash_callback(flush_all)
+    except Exception:
+        pass
 
 
 def from_env(rank: int | None = None):
@@ -166,4 +206,50 @@ def from_env(rank: int | None = None):
                 os.path.join(dirpath, f"spans_rank{r}.trace.json"), rank=r
             )
             _cache[key] = rec
-        return rec
+    _install_flush_hooks()
+    return rec
+
+
+def merge_traces(paths, out_path: str | None = None) -> dict:
+    """Merge per-rank Chrome-trace files into ONE trace with a process
+    lane per rank: every event's ``pid`` becomes its rank (taken from
+    the file's ``otherData.rank``, falling back to the recorded pid) and
+    a ``process_name`` metadata event labels each lane ``rank <r>``, so
+    perfetto shows the gang side by side.  Used by the flight-recorder
+    merge CLI; returns the merged trace document (written to
+    ``out_path`` when given)."""
+    events: list[dict] = []
+    dropped = 0
+    for i, path in enumerate(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        other = doc.get("otherData", {}) or {}
+        rank = other.get("rank", i)
+        dropped += int(other.get("dropped_events", 0) or 0)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": rank, "tid": 0,
+            "ts": 0, "args": {"name": f"rank {rank}"},
+        })
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = rank
+            events.append(ev)
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    merged = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "producer": "tpu_dist.observe.spans.merge_traces",
+            "sources": len(paths),
+            "dropped_events": dropped,
+        },
+    }
+    if out_path is not None:
+        tmp = f"{out_path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(merged, fh)
+        os.replace(tmp, out_path)
+    return merged

@@ -832,6 +832,44 @@ def pipeline_engine_loss(
     return engine(chunks_local, head_params, h)
 
 
+def stage_cost_programs(
+    stage_fns: list, stage_params: list, x0
+) -> tuple[list[dict], list, list]:
+    """Per-global-stage jitted forward/backward programs for MEASURED
+    F/B cost tables — the pipeline hook `observe.attribution.
+    measure_stage_costs` drives (ROADMAP item 4: cost-weighted schedules
+    need measured per-stage costs, and the textbook tables assume every
+    F and B tick costs the same, which an embedding-heavy stage 0 or a
+    vocab-head-heavy stage n−1 breaks).
+
+    ``stage_fns[s]`` is ``(params_s, x) -> y`` for each GLOBAL stage in
+    order; the last one returns the scalar microbatch loss.  Stages may
+    be heterogeneous in both shape and cost — the forward chain is run
+    once (eagerly) to materialize each stage's input.  Returns
+    ``(programs, inputs, outputs)`` where ``programs[s]`` carries
+    ``{"stage", "fwd", "bwd"}``: ``fwd(params, x)`` is the jitted stage
+    forward, ``bwd(params, x, cotangent)`` the jitted VJP pull (the
+    backward tick's recompute-and-pull, exactly what the 1F1B executor's
+    BWD op runs per microbatch)."""
+    if len(stage_fns) != len(stage_params):
+        raise ValueError(
+            f"{len(stage_fns)} stage fns vs {len(stage_params)} stage "
+            f"param trees"
+        )
+    progs, inputs, outputs = [], [], []
+    x = x0
+    for s, fn in enumerate(stage_fns):
+        def bwd(p, xi, g, fn=fn):
+            _, pull = jax.vjp(fn, p, xi)
+            return pull(g)
+
+        progs.append({"stage": s, "fwd": jax.jit(fn), "bwd": jax.jit(bwd)})
+        inputs.append(x)
+        x = fn(stage_params[s], x)
+        outputs.append(x)
+    return progs, inputs, outputs
+
+
 def engine_program(
     stage_fn: Callable,
     last_fn: Callable,

@@ -169,7 +169,22 @@ def at_launch(rank: int) -> None:
         # detects via pipe EOF.  The event line is flushed on emit, so it
         # survives the _exit.
         _emit_chaos_event(f"kill={rank}@{launch_attempt()}", rank)
-        os._exit(17)
+        kill_with_dump(f"kill={rank}@{launch_attempt()}")
+
+
+def kill_with_dump(clause: str, code: int = 17) -> None:
+    """The chaos hard-exit: dump the flight-recorder ring (atexit never
+    runs after ``os._exit``, so the dump must happen here), then die.
+    Exposed so tests can inject a mid-training kill through the same
+    path a launch-time ``kill=`` clause takes."""
+    try:
+        from tpu_dist.observe import flightrec
+
+        flightrec.get().record("mark", what="chaos_kill", clause=clause)
+        flightrec.crash_dump("chaos_kill")
+    except Exception:
+        pass
+    os._exit(code)
 
 
 def nan_injection_step() -> int | None:

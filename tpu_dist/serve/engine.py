@@ -164,6 +164,9 @@ class ServeEngine:
         self.lm, self.params, self.cfg = lm, params, cfg
         self._now = now
         self.events = events if events is not None else ev_mod.from_env()
+        from tpu_dist.observe import flightrec as _flightrec_mod
+
+        self._flight = _flightrec_mod.get()
         self.blocks_per_seq = math.ceil(cfg.max_seq / cfg.block_size)
         self.context_len = self.blocks_per_seq * cfg.block_size
         self.allocator = BlockAllocator(cfg.num_blocks)
@@ -463,6 +466,14 @@ class ServeEngine:
         did_prefill = self._prefill_complete(prefill_ctx)
         self.steps_with_prefill += bool(did_prefill)
         self.steps_with_decode += bool(did_decode)
+        if did_prefill or did_decode:
+            # Flight ring (observe.flightrec): one deque append per
+            # working step, so a wedged decode gang's post-mortem dump
+            # shows the serving loop's last completed steps too.
+            self._flight.record(
+                "step", step=self.step_count, phase="readback",
+                occupancy=self.occupancy(),
+            )
         self._publish(did_prefill or did_decode)
         self.step_count += 1
 

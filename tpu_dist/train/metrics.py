@@ -132,6 +132,11 @@ class TrainTelemetry:
     exported when ``TPU_DIST_METRICS_PORT`` is set).  Constructing one
     emits the run manifest (config/mesh/platform provenance)."""
 
+    # Consecutive bad (NaN-guard-skipped) steps that trigger ONE flight-
+    # recorder dump: a single skipped step is routine, a streak means
+    # the run is poisoned and the ring holds the steps that did it.
+    NAN_STREAK_DUMP = 3
+
     def __init__(
         self, *, world: int, mesh, config, trainer: str, partition=None
     ):
@@ -142,6 +147,14 @@ class TrainTelemetry:
         self.heartbeat = observe.heartbeat.from_env() if self.enabled else None
         self.spans = observe.spans.from_env()
         self.goodput = observe.heartbeat.GoodputMeter()
+        # Always-on forensic ring (observe.flightrec): step/phase records
+        # cost one deque append each, dumped only when something fires.
+        self.flight = observe.flightrec.get()
+        self.flight.record("mark", what="fit_start", trainer=trainer)
+        self._last_bad: int | None = None
+        self._last_bad_sid = 0
+        self._bad_streak = 0
+        self._nan_dumped = False
         observe.registry.maybe_serve_from_env()
         reg = observe.registry.REGISTRY
         self._steps_c = reg.counter(
@@ -256,6 +269,7 @@ class TrainTelemetry:
         with self.spans.span("dispatch", step=sid):
             out = step_fn(*args)
         dispatch_s = time.perf_counter() - t0
+        self.flight.record("step", step=sid, phase="dispatch", epoch=epoch)
         self.goodput.account_phase("dispatch", dispatch_s)
         if self.heartbeat is not None:
             # The ONE per-step beat (same file-write cadence as the
@@ -295,6 +309,9 @@ class TrainTelemetry:
         t0 = time.perf_counter()
         with self.spans.span("readback", step=sid):
             loss_f = float(pending.loss)
+        self.flight.record(
+            "step", step=sid, phase="readback", epoch=pending.epoch,
+        )
         self.goodput.account_phase("readback", time.perf_counter() - t0)
         # Per-step wall time: dispatch-to-dispatch where a next dispatch
         # exists; dispatch-to-completion for the last steps of a drain.
@@ -384,6 +401,32 @@ class TrainTelemetry:
             scale = loss_scale(opt_state)
         if bad is not None:
             self._bad_g.set(bad)
+            # NaN-guard poison streak: NAN_STREAK_DUMP consecutive
+            # skipped steps dump the flight ring once — the post-mortem
+            # shows the exact steps that went bad, not just the count.
+            # ``bad`` is cumulative and only observed at emitted steps
+            # (TPU_DIST_TELEMETRY_EVERY sampling), so "consecutive" is
+            # judged against the step delta: the streak only grows when
+            # EVERY step since the last observation was bad.
+            if self._last_bad is not None:
+                d_bad = bad - self._last_bad
+                d_steps = max(sid - self._last_bad_sid, 1)
+                if d_bad >= d_steps:
+                    self._bad_streak += d_steps
+                elif d_bad > 0:
+                    self._bad_streak = 1  # bad again, but not consecutive
+                else:
+                    self._bad_streak = 0
+            self._last_bad = bad
+            self._last_bad_sid = sid
+            if self._bad_streak >= self.NAN_STREAK_DUMP and not self._nan_dumped:
+                self._nan_dumped = True
+                from tpu_dist.observe import flightrec as flightrec_mod
+
+                self.flight.record(
+                    "mark", what="nan_streak", bad_steps=bad, step=sid,
+                )
+                flightrec_mod.crash_dump("nan_streak")
         self.events.emit(
             "step",
             step=sid,
@@ -473,6 +516,15 @@ class TrainTelemetry:
             )
 
     def preempted(self, *, signal: str, epoch: int, step: int) -> None:
+        # SIGTERM/SIGINT inside a fit is absorbed by PreemptionGuard (no
+        # process-level handler fires), so the preempt flight dump
+        # happens here, at the step boundary the guard drained to.
+        from tpu_dist.observe import flightrec as flightrec_mod
+
+        self.flight.record(
+            "mark", what="preempt", signal=signal, epoch=epoch, step=step,
+        )
+        flightrec_mod.crash_dump(f"preempt:{signal}")
         if self.enabled:
             self.spans.instant("preempt", step=self.global_step)
             self.events.emit(
@@ -485,6 +537,10 @@ class TrainTelemetry:
         must not read as stalled), ``crashed`` when the fit raised (a
         dead rank must STAY attributable to peers' watchdogs).  Never
         raises: telemetry teardown must not mask the fit's exception."""
+        try:
+            self.flight.record("mark", what="fit_end", ok=ok)
+        except Exception:
+            pass
         try:
             self.spans.save()
         except Exception:

@@ -47,6 +47,14 @@ def collective_watchdog(
     reports ranks that never heartbeat at all)."""
     fired = threading.Event()
     done = threading.Event()
+    try:
+        # What the host is waiting on, in the flight ring: if this block
+        # never finishes, the post-mortem dump's last record names it.
+        from tpu_dist.observe import flightrec as _fr
+
+        _fr.get().record("collective", what=what, timeout_s=timeout_s)
+    except Exception:
+        pass
 
     def watch():
         if not done.wait(timeout_s):
@@ -67,10 +75,14 @@ def collective_watchdog(
             )
             try:
                 from tpu_dist.observe import events as ev_mod
+                from tpu_dist.observe import flightrec as fr_mod
                 from tpu_dist.observe import heartbeat as hb_mod
 
                 hb_dir = telemetry_dir or os.environ.get(ev_mod.ENV_DIR)
                 if not hb_dir:
+                    # No event/heartbeat surface, but the flight ring may
+                    # still have somewhere to dump (TPU_DIST_FLIGHTREC_DIR).
+                    fr_mod.crash_dump(f"watchdog:{what}")
                     return
                 # Half the watchdog budget as the staleness bound: a rank
                 # quiet that long while the block overran is the
@@ -86,6 +98,13 @@ def collective_watchdog(
                     file=sys.stderr,
                     flush=True,
                 )
+                # The local flight-recorder ring is the forensic state
+                # behind the warning: dump it now (the hang may never
+                # resolve) and point the stall event at the file, so the
+                # scream is a pointer to evidence, not the only artifact.
+                dump_path = fr_mod.crash_dump(
+                    f"watchdog:{what}", dirpath=hb_dir
+                )
                 # An explicit telemetry_dir must receive the stall event
                 # even when TPU_DIST_TELEMETRY is unset.
                 ev_mod.for_dir(hb_dir).emit(
@@ -93,6 +112,7 @@ def collective_watchdog(
                     what=what,
                     timeout_s=timeout_s,
                     ranks_behind=ranks_behind,
+                    flight_dump=dump_path,
                 )
             except Exception:
                 pass  # telemetry must never break the watchdog
