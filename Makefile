@@ -11,7 +11,8 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
         scaling multiproc longcontext train-lm train-lm-modes generate \
         chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
         bench-pipeline bench-decode bench-serve serve-demo bench-mesh \
-        analyze analyze-bless attribute attribute-smoke
+        analyze analyze-bless attribute attribute-smoke memcheck \
+        memcheck-bless regress
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -21,6 +22,15 @@ analyze:  # static analyzer: lints + golden collective-plan gate (CI job)
 
 analyze-bless:  # regenerate the golden CollectivePlans under tests/goldens/
 	$(PY) -m tpu_dist.analysis --bless
+
+memcheck:  # memory analyzer: per-program HBM plans vs goldens (CI job)
+	$(PY) -m tpu_dist.analysis.memory
+
+memcheck-bless:  # regenerate the memory goldens under tests/goldens/memory/
+	$(PY) -m tpu_dist.analysis.memory --bless
+
+regress:  # latest-vs-trailing-median check over benchmarks/results/bench_runs.jsonl
+	$(PY) -m tpu_dist.observe.regress
 
 attribute:  # plan-vs-measured cost attribution (engine dp×fsdp int8 wire) + unbalanced-pipeline stage cost tables
 	$(PY) benchmarks/attribute.py --platform $(PLATFORM)

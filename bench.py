@@ -241,11 +241,17 @@ def bench_tpu_dist() -> tuple[float, dict]:
         "flops_source": flops_source,
         "platform": devs[0].platform,
     }
-    from tpu_dist.train import metrics as metrics_mod
+    from tpu_dist.observe import memory as memory_mod
 
-    mem = metrics_mod.device_memory_stats(devs[0])
-    if mem and mem.get("peak_bytes_in_use"):
-        extras["hbm_peak_mb"] = round(mem["peak_bytes_in_use"] / 1e6, 1)
+    # Peak footprint rides the same persisted record as throughput: HBM
+    # where the backend tracks it, host-RSS fallback on CPU (labeled —
+    # an RSS number must never read as a chip number in the trajectory).
+    mem = memory_mod.memory_snapshot(devs[0])
+    if mem.get("peak_bytes_in_use"):
+        extras["peak_memory_bytes"] = int(mem["peak_bytes_in_use"])
+        extras["memory_source"] = mem["source"]
+        if mem["source"] == "hbm":
+            extras["hbm_peak_mb"] = round(mem["peak_bytes_in_use"] / 1e6, 1)
     return sps, extras
 
 

@@ -154,9 +154,16 @@ def measure(args, spec: str, compress: str = "off") -> dict:
             local_tmpl, n_data, compress_mod.parse("int8")
         )
         wire_bytes = ref.bytes_exact("all_reduce")
+    from tpu_dist.observe import memory as memory_mod
+
+    # peak footprint (HBM or labeled RSS fallback) joins the persisted
+    # row, so bench_runs.jsonl carries the memory trajectory too
+    live_mem = memory_mod.memory_snapshot(dev0)
     return {
         "rule_set": rules.name,
         "compress": ccfg.wire if ccfg is not None else "off",
+        "peak_memory_bytes": live_mem.get("peak_bytes_in_use"),
+        "memory_source": live_mem.get("source"),
         "grad_bytes_on_wire": int(wire_bytes),
         "mesh_axes": spec,
         "axes": {str(k): int(v) for k, v in dict(mesh.shape).items()},

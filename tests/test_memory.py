@@ -1,0 +1,484 @@
+"""The memory observatory: static per-program HBM plans + the golden
+gate (`analysis.memory`), live watermark accounting with the CPU-sim
+host-RSS fallback (`observe.memory`), OOM forensics through the flight
+recorder, the serve-side admission budget check, the tpu_top `mem`
+line, and the bench-trajectory regression checker (`observe.regress`)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.analysis import memory as amem
+from tpu_dist.analysis.programs import canonical_program
+from tpu_dist.observe import events as ev_mod
+from tpu_dist.observe import flightrec as fr_mod
+from tpu_dist.observe import memory as omem
+from tpu_dist.observe import regress as regress_mod
+
+
+class FakeResourceExhausted(RuntimeError):
+    """Stand-in for jaxlib's XlaRuntimeError carrying XLA's
+    RESOURCE_EXHAUSTED status text."""
+
+
+def _oom_error() -> FakeResourceExhausted:
+    return FakeResourceExhausted(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 1073741824 bytes."
+    )
+
+
+def _load_tpu_top():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tpu_top",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "tpu_top.py",
+        ),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ live snapshot
+
+
+class TestSnapshot:
+    def test_cpu_sim_falls_back_to_rss(self):
+        snap = omem.memory_snapshot()
+        assert snap["source"] == "rss"
+        assert snap["bytes_in_use"] and snap["bytes_in_use"] > 0
+        assert snap["peak_bytes_in_use"] and snap["peak_bytes_in_use"] > 0
+        assert snap["bytes_limit"] is None
+
+    def test_sampler_buckets_phases(self):
+        s = omem.WatermarkSampler(flight=fr_mod.NULL)
+        s.sample("data")
+        s.sample("dispatch")
+        s.sample("dispatch")
+        summary = s.summary()
+        assert summary["source"] == "rss"
+        assert summary["phases"]["data"]["samples"] == 1
+        assert summary["phases"]["dispatch"]["samples"] == 2
+        assert summary["phases"]["dispatch"]["peak_bytes"] > 0
+
+    def test_sampler_records_watermark_moves_to_ring(self):
+        ring = fr_mod.FlightRecorder(capacity=16)
+        s = omem.WatermarkSampler(flight=ring)
+        s.sample("data")
+        # force a visible watermark move without allocating gigabytes
+        s._last_peak = 0
+        s.sample("dispatch")
+        kinds = [r["kind"] for r in ring.snapshot()]
+        assert "memory" in kinds
+        rec = [r for r in ring.snapshot() if r["kind"] == "memory"][-1]
+        assert rec["phase"] == "dispatch" and rec["delta_bytes"] > 0
+
+
+# ------------------------------------------------------------ event schema
+
+
+class TestMemoryEventSchema:
+    ENVELOPE = {"event": "memory", "time": 0.0, "rank": 0, "run_id": "r"}
+
+    def test_valid_record_passes(self):
+        rec = {
+            **self.ENVELOPE,
+            "source": "rss",
+            "bytes_in_use": 1,
+            "peak_bytes_in_use": 2,
+            "bytes_limit": None,
+            "phases": {},
+        }
+        assert ev_mod.validate_record(rec) == []
+
+    def test_missing_key_fails(self):
+        rec = {**self.ENVELOPE, "source": "rss"}
+        errs = ev_mod.validate_record(rec)
+        assert any("phases" in e for e in errs)
+        assert any("peak_bytes_in_use" in e for e in errs)
+
+    def test_emitted_event_validates(self, tmp_path):
+        logger = ev_mod.EventLogger(str(tmp_path), 0)
+        s = omem.WatermarkSampler(flight=fr_mod.NULL)
+        s.sample("checkpoint")
+        assert s.emit(logger) is not None
+        logger.close()
+        count, errors = ev_mod.validate_dir(str(tmp_path))
+        assert count == 1 and errors == []
+
+    def test_oom_event_schema(self):
+        rec = {
+            **self.ENVELOPE, "event": "oom",
+            "phase": "dispatch", "headroom_bytes": 7, "top_class": "params",
+        }
+        assert ev_mod.validate_record(rec) == []
+        assert ev_mod.validate_record({**self.ENVELOPE, "event": "oom"})
+
+
+# ----------------------------------------------------------- OOM forensics
+
+
+class TestOomForensics:
+    def test_marker_detection(self):
+        assert omem.is_resource_exhausted(_oom_error())
+        assert omem.is_resource_exhausted(MemoryError())
+        assert not omem.is_resource_exhausted(ValueError("shape mismatch"))
+
+    def test_report_names_phase_headroom_and_top_class(self):
+        report = omem.oom_report(
+            phase="dispatch",
+            snapshot={"source": "hbm", "bytes_in_use": 900,
+                      "peak_bytes_in_use": 950, "bytes_limit": 1000},
+            resident=[
+                {"class": "opt", "bytes": 300},
+                {"class": "params", "bytes": 500},
+            ],
+        )
+        assert report["phase"] == "dispatch"
+        assert report["headroom_bytes"] == 100
+        assert report["top_class"] == "params"
+        assert report["resident"][0]["class"] == "params"
+
+    def test_record_oom_dumps_flight_ring(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tmp_path))
+        fr_mod._reset_for_tests()
+        try:
+            # a fake bytes_limit injected through the sampler's last
+            # snapshot — the documented test hook for the plan-vs-live
+            # report on backends with no tracked HBM
+            sampler = omem.WatermarkSampler(flight=fr_mod.get())
+            sampler.last = {
+                "source": "hbm", "bytes_in_use": 990,
+                "peak_bytes_in_use": 999, "bytes_limit": 1000,
+            }
+            sampler.last_phase = "dispatch"
+            report = omem.record_oom(
+                _oom_error(),
+                sampler=sampler,
+                resident=[{"class": "params", "bytes": 800},
+                          {"class": "batch", "bytes": 10}],
+                events_logger=ev_mod.for_dir(str(tmp_path)),
+            )
+            assert report["phase"] == "dispatch"
+            assert report["headroom_bytes"] == 10
+            assert report["top_class"] == "params"
+            # the ring dumped (the supervisor gathers this file like
+            # any flight dump) and the mark carries the report
+            path = tmp_path / "flightrec_rank0.json"
+            assert path.exists()
+            doc = json.loads(path.read_text())
+            assert doc["reason"] == "oom"
+            marks = [r for r in doc["records"]
+                     if r.get("kind") == "mark" and r.get("what") == "oom"]
+            assert marks and marks[-1]["phase"] == "dispatch"
+            assert marks[-1]["top_class"] == "params"
+            # and the oom event validates
+            recs = [r for r in ev_mod.read_events(str(tmp_path))
+                    if r.get("event") == "oom"]
+            assert recs and ev_mod.validate_record(recs[-1]) == []
+        finally:
+            fr_mod._reset_for_tests()
+
+    def test_train_telemetry_catches_oom_on_dispatch(
+        self, tmp_path, monkeypatch
+    ):
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tmp_path))
+        fr_mod._reset_for_tests()
+        try:
+            from tpu_dist.train.metrics import TrainTelemetry
+
+            telemetry = TrainTelemetry(
+                world=1, mesh=None, config={}, trainer="test"
+            )
+
+            def exploding_step(*args):
+                raise _oom_error()
+
+            with pytest.raises(FakeResourceExhausted):
+                telemetry.run_step(
+                    exploding_step,
+                    (jnp.zeros((4,)), None, None, jnp.zeros((8,)), None),
+                    epoch=0, batch_size=8,
+                )
+            telemetry.finish(ok=False)
+            doc = json.loads(
+                (tmp_path / "flightrec_rank0.json").read_text()
+            )
+            assert doc["reason"] == "oom"
+            marks = [r for r in doc["records"]
+                     if r.get("kind") == "mark" and r.get("what") == "oom"]
+            assert marks and marks[-1]["phase"] == "dispatch"
+            # resident attribution survived the crash path
+            classes = [r["class"] for r in marks[-1].get("resident") or []]
+            assert "params" in classes and "batch" in classes
+        finally:
+            fr_mod._reset_for_tests()
+
+
+# ---------------------------------------------------- step-event hbm field
+
+
+class TestStepEventHbm:
+    def test_step_event_hbm_non_null_on_cpu_sim(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tmp_path))
+        from tpu_dist.train.metrics import TrainTelemetry
+
+        telemetry = TrainTelemetry(
+            world=1, mesh=None, config={}, trainer="test"
+        )
+        step_fn = lambda *a: (None, None, None, jnp.float32(0.25), {})  # noqa: E731
+        telemetry.run_step(
+            step_fn, (None, None, None, None, None), epoch=0, batch_size=8
+        )
+        telemetry.epoch_done(epoch=0, mean_loss=0.25, seconds=0.1)
+        telemetry.finish()
+        recs = ev_mod.read_events(str(tmp_path))
+        steps = [r for r in recs if r.get("event") == "step"]
+        assert steps, "no step event emitted"
+        hbm = steps[-1]["hbm"]
+        assert hbm is not None and hbm["source"] == "rss"
+        assert hbm["bytes_in_use"] > 0
+        # the per-epoch memory event rode along and validates
+        mems = [r for r in recs if r.get("event") == "memory"]
+        assert mems and ev_mod.validate_record(mems[-1]) == []
+        assert "dispatch" in mems[-1]["phases"]
+
+
+# ------------------------------------------------------------ memory golden
+
+
+class TestMemoryGoldens:
+    def test_bless_then_compare_roundtrip(self, tmp_path):
+        plan = amem.extract_memory_plan(canonical_program("engine_dp"))
+        amem.save_memory_golden(plan, str(tmp_path))
+        golden = amem.load_memory_golden(str(tmp_path), "engine_dp")
+        assert golden is not None
+        assert amem.compare_to_memory_golden(plan, golden) == []
+
+    def test_budget_violation_fails_readably(self, tmp_path):
+        """A golden whose bytes are SMALLER than the live plan = the
+        seeded budget violation: the gate must fail and name the
+        offending row."""
+        plan = amem.extract_memory_plan(canonical_program("engine_dp"))
+        amem.save_memory_golden(plan, str(tmp_path))
+        golden = amem.load_memory_golden(str(tmp_path), "engine_dp")
+        golden["xla"]["temp_bytes"] -= 1024
+        diffs = amem.compare_to_memory_golden(plan, golden)
+        assert diffs and any("temp_bytes" in d for d in diffs)
+        # state-class drift is caught too
+        golden2 = amem.load_memory_golden(str(tmp_path), "engine_dp")
+        golden2["state"] = [
+            r for r in golden2["state"] if r["class"] != "opt"
+        ]
+        diffs2 = amem.compare_to_memory_golden(plan, golden2)
+        assert any("opt" in d and "new memory row" in d for d in diffs2)
+
+    def test_tolerance_band(self, tmp_path):
+        plan = amem.extract_memory_plan(canonical_program("engine_dp"))
+        amem.save_memory_golden(plan, str(tmp_path))
+        golden = amem.load_memory_golden(str(tmp_path), "engine_dp")
+        golden["xla"]["temp_bytes"] = int(
+            golden["xla"]["temp_bytes"] * 1.01
+        )
+        assert amem.compare_to_memory_golden(plan, golden)  # exact: fails
+        assert amem.compare_to_memory_golden(
+            plan, golden, tolerance=0.05
+        ) == []
+
+    def test_version_skew_waives_the_gate(self, tmp_path):
+        from tpu_dist.analysis import plan as plan_mod
+
+        plan = amem.extract_memory_plan(canonical_program("engine_dp"))
+        amem.save_memory_golden(plan, str(tmp_path))
+        golden = amem.load_memory_golden(str(tmp_path), "engine_dp")
+        assert plan_mod.golden_version_skew(golden) is None
+        golden["jax_version"] = "0.0.1"
+        path = amem.memory_golden_path(str(tmp_path), "engine_dp")
+        with open(path, "w") as fh:
+            json.dump(golden, fh)
+        assert amem.main(
+            ["--programs", "engine_dp", "--goldens", str(tmp_path), "-q"]
+        ) == 0
+
+    def test_cli_bless_gate_and_corrupt(self, tmp_path, capsys):
+        goldens = str(tmp_path / "g")
+        assert amem.main(
+            ["--programs", "engine_dp", "--goldens", goldens, "--bless",
+             "-q"]
+        ) == 0
+        assert amem.main(
+            ["--programs", "engine_dp", "--goldens", goldens, "-q"]
+        ) == 0
+        path = amem.memory_golden_path(goldens, "engine_dp")
+        golden = json.load(open(path))
+        golden["xla"]["argument_bytes"] -= 8
+        with open(path, "w") as fh:
+            json.dump(golden, fh)
+        assert amem.main(
+            ["--programs", "engine_dp", "--goldens", goldens]
+        ) == 1
+        assert "MEMORY DIFF" in capsys.readouterr().out
+
+    def test_cli_missing_golden_fails(self, tmp_path):
+        assert amem.main(
+            ["--programs", "engine_dp", "--goldens",
+             str(tmp_path / "none"), "-q"]
+        ) == 1
+
+    def test_memcheck_event_emitted(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tmp_path))
+        assert amem.main(
+            ["--programs", "engine_dp", "--no-goldens", "-q"]
+        ) == 0
+        recs = [r for r in ev_mod.read_events(str(tmp_path))
+                if r.get("event") == "memcheck"]
+        assert recs and ev_mod.validate_record(recs[-1]) == []
+        assert recs[-1]["programs"] == 1
+
+    def test_engine_plan_attributes_state_classes(self):
+        plan = amem.extract_memory_plan(
+            canonical_program("engine_dp_int8")
+        )
+        classes = {r["class"] for r in plan.state}
+        # the compressed engine's EF residual is its own resident line
+        assert {"params", "opt", "ef_residual"} <= classes
+        assert plan.peak_bytes and plan.peak_bytes > 0
+
+    def test_serve_plan_attributes_weights_vs_kv(self):
+        plan = amem.extract_memory_plan(canonical_program("serve_decode"))
+        classes = {r["class"] for r in plan.state}
+        assert {"weights", "kv_pool"} <= classes
+
+
+# ------------------------------------------------------------ tpu_top mem
+
+
+class TestTpuTopMemLine:
+    def test_mem_line_renders(self, tmp_path):
+        logger = ev_mod.EventLogger(str(tmp_path), 0)
+        s = omem.WatermarkSampler(flight=fr_mod.NULL)
+        s.sample("dispatch")
+        s._last_peak = 0
+        s.sample("checkpoint")  # a visible checkpoint-phase delta
+        s.emit(logger)
+        logger.close()
+        tpu_top = _load_tpu_top()
+        out = tpu_top.render(tpu_top.collect(str(tmp_path)))
+        assert "mem" in out and "[rss]" in out
+        assert "top checkpoint" in out
+
+
+# ------------------------------------------------------- serve admission
+
+
+class TestServeMemory:
+    def _engine(self, tmp_path, bytes_limit):
+        from tpu_dist.models.transformer_lm import TransformerLM
+        from tpu_dist.serve.engine import ServeConfig, ServeEngine
+
+        lm = TransformerLM(vocab=32, dim=16, depth=1, heads=2, max_seq=64)
+        params, _ = lm.init(jax.random.key(0))
+        return ServeEngine(
+            lm, params,
+            ServeConfig(
+                max_batch=2, block_size=8, num_blocks=16, max_seq=64,
+                prefill_chunk=8, prefill_batch=1,
+                bytes_limit=bytes_limit,
+            ),
+            events=ev_mod.for_dir(str(tmp_path)),
+        )
+
+    def test_breakdown_and_grant_warning(self, tmp_path):
+        eng = self._engine(tmp_path, bytes_limit=1)
+        bd = eng.memory_breakdown()
+        assert bd["weights_bytes"] > 0
+        assert bd["kv_pool_bytes"] > 0
+        assert bd["activation_headroom_bytes"] < 0  # limit of 1 byte
+        assert bd["live"]["source"] == "rss"
+        eng.submit(np.zeros((4,), np.int32), 2)
+        eng.step()  # admission grants blocks -> over-limit warning
+        recs = [r for r in ev_mod.read_events(str(tmp_path))
+                if r.get("event") == "warning"]
+        assert recs and recs[-1]["reason"] == "kv_grant_over_limit"
+        assert recs[-1]["projected_bytes"] > recs[-1]["bytes_limit"]
+
+    def test_no_warning_under_generous_limit(self, tmp_path):
+        eng = self._engine(tmp_path, bytes_limit=1 << 40)
+        eng.submit(np.zeros((4,), np.int32), 2)
+        eng.run_until_drained()
+        recs = [r for r in ev_mod.read_events(str(tmp_path))
+                if r.get("event") == "warning"
+                and r.get("reason") == "kv_grant_over_limit"]
+        assert recs == []
+
+
+# --------------------------------------------------------------- regress
+
+
+class TestRegress:
+    def _write(self, path, rows):
+        with open(path, "w", encoding="utf-8") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+
+    def test_throughput_regression_fails(self, tmp_path):
+        path = str(tmp_path / "runs.jsonl")
+        rows = [{"metric": "m", "value": v, "platform": "cpu"}
+                for v in (100.0, 102.0, 98.0, 101.0, 40.0)]
+        self._write(path, rows)
+        out = regress_mod.check(path, threshold=0.25)
+        assert [r["status"] for r in out] == ["regressed"]
+        assert regress_mod.main([path, "--threshold", "0.25"]) == 1
+
+    def test_steady_series_passes(self, tmp_path):
+        path = str(tmp_path / "runs.jsonl")
+        self._write(path, [
+            {"metric": "m", "value": v, "platform": "cpu"}
+            for v in (100.0, 102.0, 98.0, 101.0, 99.0)
+        ])
+        assert regress_mod.main([path, "--threshold", "0.25"]) == 0
+
+    def test_memory_direction_is_lower_better(self, tmp_path):
+        path = str(tmp_path / "runs.jsonl")
+        self._write(path, [
+            {"metric": "m", "value": 100.0, "peak_memory_bytes": b,
+             "platform": "cpu"}
+            for b in (1000, 1010, 990, 1005, 2000)
+        ])
+        out = regress_mod.check(path, threshold=0.25)
+        by_field = {r["field"]: r["status"] for r in out}
+        assert by_field["peak_memory_bytes"] == "regressed"
+        assert by_field["value"] == "ok"
+
+    def test_short_history_is_new_not_failed(self, tmp_path):
+        path = str(tmp_path / "runs.jsonl")
+        self._write(path, [
+            {"metric": "m", "value": 1.0, "platform": "cpu"},
+            {"metric": "m", "value": 99.0, "platform": "cpu"},
+        ])
+        out = regress_mod.check(path, threshold=0.25)
+        assert [r["status"] for r in out] == ["new"]
+        assert regress_mod.main([path]) == 0
+
+    def test_platform_split_isolates_fallback_rounds(self, tmp_path):
+        path = str(tmp_path / "runs.jsonl")
+        rows = [{"metric": "m", "value": 1000.0, "platform": "tpu"}
+                for _ in range(4)]
+        rows.append({"metric": "m", "value": 10.0, "platform": "cpu"})
+        self._write(path, rows)
+        out = regress_mod.check(path, threshold=0.25)
+        # the cpu row is a NEW series, not a regression of the tpu one
+        assert all(r["status"] in ("ok", "new") for r in out)
+
+    def test_real_bench_runs_file_parses(self):
+        # the repo's own trajectory must at least parse and report
+        rows = regress_mod.check(regress_mod.default_path())
+        assert isinstance(rows, list)

@@ -31,7 +31,7 @@ from tpu_dist.observe import flightrec as fr_mod  # noqa: E402
 from tpu_dist.observe import heartbeat as hb_mod  # noqa: E402
 
 NOTABLE = ("retry", "chaos", "stall", "preempt", "checkpoint", "warning",
-           "flight_dump")
+           "flight_dump", "oom")
 
 
 def _fmt(value, spec: str = "", none: str = "--") -> str:
@@ -93,6 +93,7 @@ def empty_state(dirpath: str) -> dict:
         "serve": None,     # last decode_step record (serving runs)
         "analysis": None,  # last static-analyzer summary (make analyze)
         "attr": None,      # last attribution report (make attribute)
+        "mem": None,       # last memory event (observe.memory sampler)
         "flight": None,    # merged flight-recorder divergence, if dumps exist
     }
 
@@ -116,6 +117,8 @@ def update(state: dict, records: list) -> dict:
             state["analysis"] = rec
         elif kind == "attribution":
             state["attr"] = rec
+        elif kind == "memory":
+            state["mem"] = rec
         if kind in NOTABLE:
             state["notable"].append(rec)
             del state["notable"][:-64]  # bounded; render shows the tail
@@ -178,6 +181,8 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
         hbm = s.get("hbm") or {}
         hbm_s = (
             f"{hbm['bytes_in_use'] / 1e6:,.0f}MB"
+            # a host-RSS fallback reading must never pass for HBM
+            + ("(rss)" if hbm.get("source") == "rss" else "")
             if hbm.get("bytes_in_use")
             else "--"
         )
@@ -257,6 +262,32 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
             + (f"  {cls_s}" if cls_s else "")
             + f"  golden {at.get('golden') or '--'}"
             f"  ({_age(at.get('time'), now)})"
+        )
+
+    mm = state.get("mem")
+    if mm:
+        # live memory accounting (observe.memory): latest watermark
+        # snapshot + the phase that built the footprint.  The source
+        # label keeps an RSS fallback from reading as a chip number.
+        def _mb(v):
+            return f"{v / 1e6:,.0f}MB" if v is not None else "--"
+
+        phases = mm.get("phases") or {}
+        top = max(
+            (p for p in phases.items() if p[1].get("delta_bytes")),
+            key=lambda p: p[1]["delta_bytes"], default=None,
+        )
+        top_s = (
+            f"  top {top[0]} +{_mb(top[1]['delta_bytes'])}"
+            if top else ""
+        )
+        lines.append(
+            f"mem  [{mm.get('source', '?')}]"
+            f"  in-use {_mb(mm.get('bytes_in_use'))}"
+            f"  peak {_mb(mm.get('peak_bytes_in_use'))}"
+            f"  limit {_mb(mm.get('bytes_limit'))}"
+            + top_s
+            + f"  ({_age(mm.get('time'), now)})"
         )
 
     fl = state.get("flight")

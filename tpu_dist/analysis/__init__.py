@@ -11,9 +11,14 @@ Three layers (see docs/analysis.md):
   rules, replicated residency, reused PRNG keys.
 - `programs`: the canonical entry-program registry
   (`canonical_program`) the CLI and CI gate run over.
+- `memory`: static per-program HBM memory plans (`extract_memory_plan`
+  → `MemoryPlan` from XLA's compiled memory sections + rule-engine
+  state attribution) and the peak-HBM golden gate under
+  ``tests/goldens/memory/``.
 
-CLI: ``python -m tpu_dist.analysis`` (``make analyze`` /
-``make analyze-bless``).
+CLIs: ``python -m tpu_dist.analysis`` (``make analyze`` /
+``make analyze-bless``) and ``python -m tpu_dist.analysis.memory``
+(``make memcheck`` / ``make memcheck-bless``).
 """
 
 from tpu_dist.analysis.lints import (
@@ -23,6 +28,13 @@ from tpu_dist.analysis.lints import (
     find_callbacks,
     find_reused_keys,
     run_lints,
+)
+from tpu_dist.analysis.memory import (
+    MemoryPlan,
+    compare_to_memory_golden,
+    extract_memory_plan,
+    load_memory_golden,
+    save_memory_golden,
 )
 from tpu_dist.analysis.plan import (
     Collective,
@@ -49,9 +61,14 @@ __all__ = [
     "Collective",
     "CollectivePlan",
     "Finding",
+    "MemoryPlan",
     "canonical_program",
     "canonical_programs",
     "compare_to_golden",
+    "compare_to_memory_golden",
+    "extract_memory_plan",
+    "load_memory_golden",
+    "save_memory_golden",
     "compiled_text",
     "diff_plans",
     "donated_buffer_count",

@@ -18,6 +18,13 @@ is the measurement substrate the ROADMAP's perf PRs cite:
                 records, dumped on watchdog fire / signals / chaos kill /
                 crashes; ``python -m tpu_dist.observe.flightrec merge``
                 clock-aligns the dumps and names the divergent rank
+- `memory`    — live memory snapshots (HBM, host-RSS fallback on
+                CPU-sim), phase-bucketed watermark accounting, and OOM
+                forensics (`record_oom` → flight dump + ``oom`` event)
+- `regress`   — trailing-median regression checker over the persisted
+                bench trajectory (``python -m tpu_dist.observe.regress``;
+                a ``-m`` CLI like flightrec's merge — import it
+                explicitly, it is not re-exported here)
 
 Everything here is stdlib-only and import-light: these modules are
 imported from bootstrap paths (`comm.launch._child`,
@@ -26,6 +33,16 @@ exception is `observe.attribution` (plan-vs-measured cost attribution —
 it EXECUTES compiled programs, so it needs jax); import it explicitly.
 """
 
-from tpu_dist.observe import events, flightrec, heartbeat, registry, spans
+from tpu_dist.observe import (
+    events,
+    flightrec,
+    heartbeat,
+    memory,
+    registry,
+    spans,
+)
 
-__all__ = ["events", "flightrec", "heartbeat", "registry", "spans"]
+__all__ = [
+    "events", "flightrec", "heartbeat", "memory", "registry",
+    "spans",
+]

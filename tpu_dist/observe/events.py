@@ -110,6 +110,20 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # (kind, axes, dtype) collective class, with plan payload bytes and
     # achieved wire GB/s per class
     "attribution": ("program", "step_time", "compute_seconds", "classes"),
+    # live memory accounting (observe.memory.WatermarkSampler): the
+    # latest snapshot (source "hbm" on tracked backends, "rss" on the
+    # CPU-sim host fallback) plus per-phase watermark-delta buckets
+    "memory": (
+        "source", "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+        "phases",
+    ),
+    # OOM forensics (observe.memory.record_oom): RESOURCE_EXHAUSTED on
+    # a step path — the failing phase, the headroom at failure, and the
+    # largest resident class; the full report rides the flight dump
+    "oom": ("phase", "headroom_bytes", "top_class"),
+    # static memory-plan gate (python -m tpu_dist.analysis.memory /
+    # make memcheck): programs checked + golden gate status
+    "memcheck": ("programs", "golden"),
 }
 
 

@@ -1,0 +1,221 @@
+"""Trailing-median regression checker over the persisted bench trajectory.
+
+`bench.persist_event` has been appending every benchmark invocation —
+throughput, wire bytes, attribution, and (since this PR) peak memory —
+to ``benchmarks/results/bench_runs.jsonl``, but nothing ever READ the
+trajectory: a silent 2× throughput drop or footprint blow-up only
+surfaced when a human happened to diff two JSON lines.  This module is
+the automated reader:
+
+    python -m tpu_dist.observe.regress                 # default file
+    python -m tpu_dist.observe.regress --threshold 0.3 --window 8
+
+For every metric series in the file it compares the LATEST row against
+the TRAILING MEDIAN of the preceding window and exits nonzero when the
+deviation crosses the threshold in the metric's bad direction:
+
+- ``value`` fields are throughput-shaped (higher is better) — a latest
+  reading below ``median * (1 - threshold)`` fails;
+- byte-shaped fields (``peak_memory_bytes``, ``grad_bytes_on_wire``,
+  any field with a ``bytes`` component) are lower-better — a latest
+  reading above ``median * (1 + threshold)`` fails.
+
+Series are keyed by ``(metric, memory_source/platform provenance)`` so
+a CPU-fallback round is never judged against a TPU median — the
+trajectory's known failure mode (ROADMAP: "TPU probe falls back every
+round").  Series with fewer than ``--min-history`` prior rows are
+reported as ``new`` and never fail.  Stdlib-only, like the rest of
+`tpu_dist.observe`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+
+DEFAULT_THRESHOLD = 0.5
+DEFAULT_WINDOW = 8
+DEFAULT_MIN_HISTORY = 3
+
+def field_direction(field: str) -> str | None:
+    """The bad direction of one row field, or None when the field is
+    not a checked metric: ``value`` is throughput-shaped (higher is
+    better); any byte-shaped field (``peak_memory_bytes``,
+    ``grad_bytes_on_wire``, ...) is a footprint — growth is the
+    regression."""
+    if field == "value":
+        return "higher"
+    if "bytes" in field.split("_"):
+        return "lower"
+    return None
+
+
+def checked_fields(rec: dict) -> list[tuple[str, str]]:
+    """The ``(field, direction)`` pairs to gate on one row: ``value``
+    plus every top-level numeric byte-shaped field the row carries."""
+    out = []
+    for key, val in rec.items():
+        direction = field_direction(key)
+        if direction is not None and isinstance(val, (int, float)):
+            out.append((key, direction))
+    return out
+
+
+def default_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "benchmarks", "results", "bench_runs.jsonl")
+
+
+def load_rows(path: str) -> list[dict]:
+    """Every parseable JSON row of one JSONL file, file order (=
+    chronological: the file is append-only)."""
+    rows = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict):
+                    rows.append(rec)
+    except OSError:
+        return []
+    return rows
+
+
+def _series_key(rec: dict, field: str) -> tuple | None:
+    metric = rec.get("metric")
+    if not metric or not isinstance(rec.get(field), (int, float)):
+        return None
+    # provenance split: CPU-fallback rounds must not be judged against
+    # a TPU median (or vice versa)
+    platform = rec.get("platform")
+    if platform is None:
+        platform = (rec.get("provenance") or {}).get("backend")
+    if platform is None and rec.get("memory_source") == "hbm":
+        platform = "tpu"  # an HBM reading implies a tracked accelerator
+    # sub-series discriminators some benches carry (one metric, many
+    # configurations — e.g. mesh_rule_set × compress)
+    sub = tuple(
+        str(rec[k]) for k in ("rule_set", "compress", "bench", "unit")
+        if rec.get(k) is not None
+    )
+    return (str(metric), field, str(platform)) + sub
+
+
+def check(
+    path: str,
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    window: int = DEFAULT_WINDOW,
+    min_history: int = DEFAULT_MIN_HISTORY,
+    skip: tuple = (),
+) -> list[dict]:
+    """One verdict row per metric series: ``{series, field, direction,
+    latest, median, n_history, delta, status}`` with status ``ok`` /
+    ``regressed`` / ``new`` (not enough history to judge) /
+    ``acknowledged`` (would have regressed, but the series matches a
+    ``skip`` substring — the way to accept a known drop without
+    rewriting the append-only record)."""
+    series: dict[tuple, list[float]] = {}
+    for rec in load_rows(path):
+        for field, _ in checked_fields(rec):
+            key = _series_key(rec, field)
+            if key is not None:
+                series.setdefault(key, []).append(float(rec[field]))
+    out = []
+    for key in sorted(series, key=repr):
+        values = series[key]
+        field = key[1]
+        direction = field_direction(field) or "higher"
+        latest, history = values[-1], values[:-1][-window:]
+        row = {
+            "series": ":".join(str(k) for k in (key[0], *key[2:])),
+            "field": field,
+            "direction": direction,
+            "latest": latest,
+            "n_history": len(history),
+            "median": None,
+            "delta": None,
+            "status": "new",
+        }
+        if len(history) >= min_history:
+            med = statistics.median(history)
+            row["median"] = med
+            if med != 0:
+                delta = (latest - med) / abs(med)
+                row["delta"] = round(delta, 4)
+                bad = (
+                    delta < -threshold if direction == "higher"
+                    else delta > threshold
+                )
+                row["status"] = "regressed" if bad else "ok"
+                if bad and any(s in row["series"] for s in skip):
+                    row["status"] = "acknowledged"
+            else:
+                row["status"] = "ok"
+        out.append(row)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_dist.observe.regress",
+        description="latest-vs-trailing-median check over bench_runs.jsonl",
+    )
+    ap.add_argument("path", nargs="?", default=default_path(),
+                    help="JSONL bench record (default: "
+                    "benchmarks/results/bench_runs.jsonl)")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="relative deviation that fails (default 0.5 — "
+                    "CPU-sim benches are noisy; tighten on real chips)")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing rows the median is taken over")
+    ap.add_argument("--min-history", type=int, default=DEFAULT_MIN_HISTORY,
+                    help="prior rows required before a series can fail")
+    ap.add_argument("--skip", default="",
+                    help="comma-separated series substrings whose "
+                    "regressions are acknowledged (reported, exit 0)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable verdict rows")
+    args = ap.parse_args(argv)
+
+    rows = check(
+        args.path, threshold=args.threshold, window=args.window,
+        min_history=args.min_history,
+        skip=tuple(s.strip() for s in args.skip.split(",") if s.strip()),
+    )
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        if not rows:
+            print(f"no metric series under {args.path}")
+        for r in rows:
+            med = f"{r['median']:,.1f}" if r["median"] is not None else "--"
+            delta = f"{r['delta']:+.1%}" if r["delta"] is not None else "--"
+            flag = "REGRESSED" if r["status"] == "regressed" else r["status"]
+            print(
+                f"{flag:>9}  {r['series']:<60} {r['field']:<18}"
+                f" latest {r['latest']:,.1f}  median[{r['n_history']}] {med}"
+                f"  delta {delta}"
+            )
+    regressed = [r for r in rows if r["status"] == "regressed"]
+    if regressed:
+        print(f"{len(regressed)} series regressed past "
+              f"±{args.threshold:.0%} of the trailing median",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

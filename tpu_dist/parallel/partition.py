@@ -71,6 +71,7 @@ __all__ = [
     "parse_rules",
     "partition_summary",
     "per_device_bytes",
+    "state_bytes_by_class",
     "resolve_rules",
     "resolve_trainer_rules",
     "rule_match_report",
@@ -705,6 +706,11 @@ def per_device_bytes(tree: Any, device=None) -> int:
     sharded leaf counts its local shard)."""
     total = 0
     for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            # abstract leaves (analysis programs): logical bytes — the
+            # caller's tree is single-device or already shard-shaped
+            total += int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            continue
         if not hasattr(leaf, "addressable_shards"):
             total += np.asarray(leaf).nbytes
             continue
@@ -715,6 +721,37 @@ def per_device_bytes(tree: Any, device=None) -> int:
             s.data.nbytes for s in leaf.addressable_shards if s.device == dev
         )
     return total
+
+
+def state_bytes_by_class(params=None, opt_state=None, device=None,
+                         **extra) -> list[dict]:
+    """Per-device resident bytes bucketed into the classes an OOM (or a
+    memory plan) should name: ``params``, ``opt``, and — when the
+    optimizer state carries the compressed-wire EF wrapper — the
+    ``ef_residual`` split out of ``opt`` (the residual is n× a gradient,
+    so it deserves its own line).  Extra kwargs add caller-labeled trees
+    (``batch=...``, ``weights=...``, ``kv_pool=...``).  Returns
+    ``[{class, bytes}]`` rows, zero-byte classes dropped."""
+    trees: list[tuple[str, Any]] = []
+    if params is not None:
+        trees.append(("params", params))
+    if opt_state is not None:
+        if isinstance(opt_state, dict) and "ef" in opt_state:
+            ef = opt_state["ef"]
+            trees.append(("opt", {k: v for k, v in opt_state.items()
+                                  if k != "ef"}))
+            trees.append(("ef_residual", ef.get("residual")))
+        else:
+            trees.append(("opt", opt_state))
+    trees.extend(extra.items())
+    rows = []
+    for name, tree in trees:
+        if tree is None:
+            continue
+        nbytes = per_device_bytes(tree, device)
+        if nbytes:
+            rows.append({"class": name, "bytes": int(nbytes)})
+    return rows
 
 
 # ----------------------------------------------------------- train step

@@ -78,6 +78,11 @@ class ServeConfig:
     prefill_batch: int = 4
     decode_event_every: int = 8
     cache_dtype: object = None
+    # HBM budget for the admission memory check (bytes).  None = read
+    # the live device limit (`observe.memory.memory_snapshot`; absent
+    # on CPU-sim).  A grant that would push weights + granted KV blocks
+    # past this emits a `warning` event — tests inject a fake limit.
+    bytes_limit: int | None = None
 
 
 @dataclass
@@ -165,8 +170,10 @@ class ServeEngine:
         self._now = now
         self.events = events if events is not None else ev_mod.from_env()
         from tpu_dist.observe import flightrec as _flightrec_mod
+        from tpu_dist.observe import memory as _memory_mod
 
         self._flight = _flightrec_mod.get()
+        self._memory = _memory_mod.WatermarkSampler(flight=self._flight)
         self.blocks_per_seq = math.ceil(cfg.max_seq / cfg.block_size)
         self.context_len = self.blocks_per_seq * cfg.block_size
         self.allocator = BlockAllocator(cfg.num_blocks)
@@ -234,6 +241,29 @@ class ServeEngine:
         self._h_tpot = REGISTRY.histogram(
             "tpu_dist_serve_tpot_seconds", "per-token decode latency"
         )
+        # Memory breakdown: what this engine keeps resident — weights
+        # vs KV pool (allocated in full at init; blocks are GRANTS of
+        # that pool) vs whatever headroom the device has left for
+        # activations.  `bytes_limit` comes from the config (tests/
+        # operators) or the live device limit (None on CPU-sim).
+        from tpu_dist.parallel import per_device_bytes
+
+        self.weights_bytes = int(per_device_bytes(self.params))
+        self.kv_pool_bytes = int(per_device_bytes(self.cache))
+        # the pool holds num_blocks grantable blocks + 1 scratch block
+        self.kv_block_bytes = self.kv_pool_bytes // (cfg.num_blocks + 1)
+        self.bytes_limit = (
+            cfg.bytes_limit
+            if cfg.bytes_limit is not None
+            else self._memory.snapshot().get("bytes_limit")
+        )
+        REGISTRY.gauge(
+            "tpu_dist_serve_weights_bytes", "model weight bytes resident"
+        ).set(self.weights_bytes)
+        REGISTRY.gauge(
+            "tpu_dist_serve_kv_pool_bytes",
+            "paged KV pool bytes resident (allocated at init)",
+        ).set(self.kv_pool_bytes)
 
     # ------------------------------------------------------------- jit fns
 
@@ -366,6 +396,82 @@ class ServeEngine:
             ),
         }
 
+    # ------------------------------------------------------------- memory
+
+    def memory_breakdown(self) -> dict:
+        """The serve-side resident story: weights vs KV pool (split
+        into granted and free blocks) vs activation headroom against
+        ``bytes_limit`` (None when no limit is known — CPU-sim without
+        a configured budget).  The `observe.memory` snapshot rides
+        along so plan (this breakdown) and live (HBM/RSS) are one
+        record."""
+        granted = self.allocator.used * self.kv_block_bytes
+        headroom = (
+            int(self.bytes_limit) - self.weights_bytes - self.kv_pool_bytes
+            if self.bytes_limit is not None else None
+        )
+        return {
+            "weights_bytes": self.weights_bytes,
+            "kv_pool_bytes": self.kv_pool_bytes,
+            "kv_granted_bytes": int(granted),
+            "kv_block_bytes": self.kv_block_bytes,
+            "bytes_limit": self.bytes_limit,
+            "activation_headroom_bytes": headroom,
+            "live": self._memory.snapshot(),
+        }
+
+    def _resident_rows(self) -> list[dict]:
+        return [
+            {"class": "weights", "bytes": self.weights_bytes},
+            {"class": "kv_pool", "bytes": self.kv_pool_bytes},
+        ]
+
+    def _check_block_grant(self, req: Request, need: int) -> None:
+        """Admission memory check: warn (once per request) when this
+        grant pushes weights + granted KV blocks past ``bytes_limit``
+        — the pool itself is preallocated, so the grant cannot OOM by
+        itself, but a plan whose grants exceed the budget means the
+        pool was sized past the device and the NEXT activation spike
+        will be the thing that dies.  Called AFTER ``alloc(need)``, so
+        ``allocator.used`` already includes this grant; admission runs
+        once per request, so no dedup is needed."""
+        if self.bytes_limit is None:
+            return
+        projected = (
+            self.weights_bytes + self.allocator.used * self.kv_block_bytes
+        )
+        if projected <= self.bytes_limit:
+            return
+        self._flight.record(
+            "memory", phase="admit", projected_bytes=int(projected),
+            bytes_limit=int(self.bytes_limit),
+        )
+        self.events.emit(
+            "warning",
+            reason="kv_grant_over_limit",
+            request_id=req.request_id,
+            blocks=need,
+            projected_bytes=int(projected),
+            bytes_limit=int(self.bytes_limit),
+            over_bytes=int(projected - self.bytes_limit),
+        )
+
+    def _oom(self, exc: BaseException, phase: str) -> None:
+        """RESOURCE_EXHAUSTED on a serving step path: plan-vs-live OOM
+        forensics through the flight recorder (`observe.memory`)."""
+        from tpu_dist.observe import memory as _memory_mod
+
+        if not _memory_mod.is_resource_exhausted(exc):
+            return
+        _memory_mod.record_oom(
+            exc,
+            phase=phase,
+            sampler=self._memory,
+            resident=self._resident_rows(),
+            plan=self.memory_breakdown(),
+            events_logger=self.events,
+        )
+
     # ---------------------------------------------------------- front door
 
     def submit(self, prompt, max_new_tokens: int, *,
@@ -460,10 +566,31 @@ class ServeEngine:
             len(self._prefillq) > self.cfg.prefill_batch
             and self.occupancy() <= self.cfg.max_batch // 2
         )
-        decode_toks = None if prefer_prefill else self._decode_dispatch()
-        prefill_ctx = self._prefill_dispatch()
-        did_decode = self._decode_complete(decode_toks)
-        did_prefill = self._prefill_complete(prefill_ctx)
+        try:
+            decode_toks = None if prefer_prefill else self._decode_dispatch()
+        except Exception as e:
+            self._oom(e, "decode")
+            raise
+        try:
+            prefill_ctx = self._prefill_dispatch()
+        except Exception as e:
+            self._oom(e, "prefill")
+            raise
+        try:
+            did_decode = self._decode_complete(decode_toks)
+        except Exception as e:
+            self._oom(e, "decode")
+            raise
+        try:
+            did_prefill = self._prefill_complete(prefill_ctx)
+        except Exception as e:
+            self._oom(e, "prefill")
+            raise
+        if self.events.enabled and not self._warming:
+            if did_decode:
+                self._memory.sample("decode")
+            if did_prefill:
+                self._memory.sample("prefill")
         self.steps_with_prefill += bool(did_prefill)
         self.steps_with_decode += bool(did_decode)
         if did_prefill or did_decode:
@@ -501,6 +628,7 @@ class ServeEngine:
             blocks = self.allocator.alloc(need)
             if blocks is None:
                 break  # head-of-line blocks; FIFO stays deterministic
+            self._check_block_grant(req, need)
             self.queue.popleft()
             s = free[0]
             req.slot, req.blocks, req.state = s, blocks, "prefill"

@@ -671,6 +671,7 @@ class LMTrainer:
                             "data_next", step=telemetry.next_step_id
                         ):
                             batch = next(batches, None)
+                        telemetry.sample_memory("data")
                         if batch is None:
                             break
                         key = jax.random.fold_in(

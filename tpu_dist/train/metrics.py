@@ -97,7 +97,9 @@ def device_memory_stats(device=None) -> dict | None:
     """Live HBM statistics for one device (``bytes_in_use``,
     ``peak_bytes_in_use``, ``bytes_limit``, ...) or None where the
     backend doesn't track them (CPU-sim).  The `watch nvidia-smi` analog
-    (tuto.md:381), pulled from the runtime instead of a side tool."""
+    (tuto.md:381), pulled from the runtime instead of a side tool.
+    Telemetry consumers want `observe.memory.memory_snapshot` instead —
+    it labels the source and falls back to host RSS on CPU-sim."""
     dev = device or jax.devices()[0]
     stats = getattr(dev, "memory_stats", lambda: None)()
     return dict(stats) if stats else None
@@ -151,6 +153,10 @@ class TrainTelemetry:
         # cost one deque append each, dumped only when something fires.
         self.flight = observe.flightrec.get()
         self.flight.record("mark", what="fit_start", trainer=trainer)
+        # Live memory accounting (observe.memory): phase-bucketed
+        # watermark sampler — HBM where tracked, host-RSS on CPU-sim.
+        # Sampling is gated on telemetry; the OOM catch is always on.
+        self.memory = observe.memory.WatermarkSampler(flight=self.flight)
         self._last_bad: int | None = None
         self._last_bad_sid = 0
         self._bad_streak = 0
@@ -266,9 +272,17 @@ class TrainTelemetry:
         prev = self._pending_tail
         if prev is not None and prev.d2d_seconds is None:
             prev.d2d_seconds = t0 - prev.t_dispatch
-        with self.spans.span("dispatch", step=sid):
-            out = step_fn(*args)
+        try:
+            with self.spans.span("dispatch", step=sid):
+                out = step_fn(*args)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED on the dispatch path: build the
+            # plan-vs-live report and dump the flight ring BEFORE the
+            # exception unwinds the fit (observe.memory OOM forensics).
+            self._maybe_record_oom(e, phase="dispatch", step_args=args)
+            raise
         dispatch_s = time.perf_counter() - t0
+        self.sample_memory("dispatch")
         self.flight.record("step", step=sid, phase="dispatch", epoch=epoch)
         self.goodput.account_phase("dispatch", dispatch_s)
         if self.heartbeat is not None:
@@ -299,6 +313,46 @@ class TrainTelemetry:
         self._pending_tail = pending
         return out, pending
 
+    def sample_memory(self, phase: str) -> None:
+        """One phase-bucketed watermark sample (no-op when telemetry is
+        off — the snapshot read is cheap but not free on the hot path)."""
+        if self.enabled:
+            self.memory.sample(phase)
+
+    def _resident_rows(self, step_args) -> list | None:
+        """Per-class resident bytes from a step's args — best-effort:
+        on the OOM path some buffers may already be donated/deleted, and
+        the forensics must never mask the real exception."""
+        try:
+            from tpu_dist import parallel
+
+            params = step_args[0] if len(step_args) > 0 else None
+            opt = step_args[2] if len(step_args) > 2 else None
+            batch = step_args[3] if len(step_args) > 3 else None
+            return parallel.state_bytes_by_class(
+                params, opt, batch=batch
+            ) or None
+        except Exception:
+            return None
+
+    def _maybe_record_oom(self, exc, *, phase: str, step_args=()) -> None:
+        """RESOURCE_EXHAUSTED forensics on the step path: name the
+        phase, the headroom, and the top resident class, then dump the
+        flight ring (`observe.memory.record_oom`).  Any other exception
+        passes through untouched."""
+        from tpu_dist.observe import memory as memory_mod
+
+        if not memory_mod.is_resource_exhausted(exc):
+            return
+        self.flight.record("mark", what="oom_detected", phase=phase)
+        memory_mod.record_oom(
+            exc,
+            phase=phase,
+            sampler=self.memory,
+            resident=self._resident_rows(step_args),
+            events_logger=self.events,
+        )
+
     def complete_step(self, pending) -> float:
         """Read back one pending step's results and emit its telemetry —
         the ``readback`` span and the step event carry the step id
@@ -307,8 +361,15 @@ class TrainTelemetry:
         as a float."""
         sid = pending.step_id
         t0 = time.perf_counter()
-        with self.spans.span("readback", step=sid):
-            loss_f = float(pending.loss)
+        try:
+            with self.spans.span("readback", step=sid):
+                loss_f = float(pending.loss)
+        except Exception as e:
+            # a deferred allocation failure surfaces at readback — same
+            # forensics, attributed to the readback phase
+            self._maybe_record_oom(e, phase="readback")
+            raise
+        self.sample_memory("readback")
         self.flight.record(
             "step", step=sid, phase="readback", epoch=pending.epoch,
         )
@@ -439,7 +500,9 @@ class TrainTelemetry:
             mfu=flops_mod.mfu(self._flops, step_seconds),
             bad_steps=bad,
             loss_scale=scale,
-            hbm=device_memory_stats(),
+            # HBM where the backend tracks it, host-RSS fallback on
+            # CPU-sim (labeled source: "rss") — non-null on every mesh
+            hbm=self.memory.snapshot(),
             bubble_fraction=self.bubble_fraction,
             **extra,
         )
@@ -505,8 +568,12 @@ class TrainTelemetry:
                 mesh=self._partition_summary,
                 **extra,
             )
+            # the per-epoch memory event: latest watermark snapshot +
+            # phase-bucketed deltas (observe.memory schema)
+            self.memory.emit(self.events)
 
     def checkpoint_done(self, *, path, epoch: int, seconds: float) -> None:
+        self.sample_memory("checkpoint")
         if self.enabled:
             self.events.emit(
                 "checkpoint",

@@ -562,6 +562,7 @@ class Trainer:
                                 "data_next", step=telemetry.next_step_id
                             ):
                                 batch = next(batches, None)
+                            telemetry.sample_memory("data")
                             if batch is None:
                                 break
                             # fold epoch and batch index separately: no
