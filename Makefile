@@ -12,7 +12,7 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
         chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
         bench-pipeline bench-decode bench-serve serve-demo bench-mesh \
         analyze analyze-bless attribute attribute-smoke memcheck \
-        memcheck-bless regress
+        memcheck-bless regress advise advise-smoke costcheck
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -31,6 +31,15 @@ memcheck-bless:  # regenerate the memory goldens under tests/goldens/memory/
 
 regress:  # latest-vs-trailing-median check over benchmarks/results/bench_runs.jsonl
 	$(PY) -m tpu_dist.observe.regress
+
+advise:  # static auto-sharding advisor: rank (mesh_axes, compress) candidates for the CPU-sim LM
+	$(PY) -m tpu_dist.analysis.advise --model lm --chips $(WORLD)
+
+advise-smoke:  # CI gate: tiny model, two candidates; ranking + advice event must validate
+	$(PY) -m tpu_dist.analysis.advise --smoke
+
+costcheck:  # calibration gate: predicted-vs-measured step time within the blessed tolerance (CI job)
+	$(PY) -m tpu_dist.analysis.advise --costcheck
 
 attribute:  # plan-vs-measured cost attribution (engine dp×fsdp int8 wire) + unbalanced-pipeline stage cost tables
 	$(PY) benchmarks/attribute.py --platform $(PLATFORM)

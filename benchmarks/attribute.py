@@ -187,13 +187,19 @@ def run_stage_costs(args) -> tuple[list, list]:
     if not args.no_persist:
         path = attr_mod.persist_stage_costs(rows)
         log(f"persisted {len(rows)} stage rows -> {path}")
-        # row-parse gate: the file item 4's generator will consume must
-        # actually round-trip
-        with open(path, encoding="utf-8") as fh:
-            tail = [ln for ln in fh if ln.strip()][-len(rows):]
-        for ln in tail:
-            rec = json.loads(ln)
-            for keyname in ("stage", "fwd_s", "bwd_s", "n_stages", "model"):
+        # row-parse gate through the SHARED loader (the exact read path
+        # item 4's generator and the cost model consume): the rows just
+        # written must come back with their provenance intact
+        back = attr_mod.load_stage_cost_rows(
+            path, spec_hash=rows[0].get("spec_hash") if rows else None,
+        )[-len(rows):]
+        if len(back) != len(rows):
+            errors.append(
+                f"stage_costs round-trip: wrote {len(rows)} rows, loader "
+                f"returned {len(back)} for this spec_hash"
+            )
+        for rec in back:
+            for keyname in ("spec_hash", "mesh_shape"):
                 if keyname not in rec:
                     errors.append(f"stage_costs row missing {keyname!r}")
     return rows, errors

@@ -358,6 +358,89 @@ class TestFallthroughLint:
         assert "mystery/w" in findings[0].message
 
 
+class TestUnplannedReshardLint:
+    def test_fallthrough_user_rule_forcing_gather_fires(self):
+        """The seeded violation: a user rule pinning a Dense weight's
+        OUTPUT dim over dp inside a plain-dp rule set forces GSPMD to
+        all-gather over dp inside the step — a replication round-trip
+        no role of the rule set derives."""
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            prog = _engine(
+                f"dp={N}", name="seeded_reshard",
+                user_rules=[(r"1/w$", "None,dp")],
+            )
+        findings = L.lint_unplanned_reshard(prog)
+        assert findings
+        assert all(f.lint == "unplanned-reshard" for f in findings)
+        assert any(f.detail["kind"] == "all-gather" for f in findings)
+        assert "not derivable from rule set 'dp'" in findings[0].message
+
+    def test_gather_over_dp_is_planned_under_zero1(self):
+        # zero1 shards the update over dp: its output all-gather is part
+        # of the plan, not a reshard (and plain dp's grad reduce is the
+        # reduce-class allowance)
+        assert L.lint_unplanned_reshard(
+            canonical_program("engine_zero1")) == []
+        assert L.lint_unplanned_reshard(
+            canonical_program("engine_dp")) == []
+
+    def test_permute_and_foreign_axis_flag(self):
+        from tpu_dist.analysis.plan import Collective, CollectivePlan
+
+        base = canonical_program("engine_dp")
+        fake = AnalysisProgram(
+            name="perm", fn=base.fn, args=base.args, mesh=base.mesh,
+            built=base.built,
+        )
+        fake._cache["plan"] = CollectivePlan(
+            name="perm", mesh_axes={"dp": N},
+            collectives=(
+                # the engine plans no rings: any permute is unplanned
+                Collective(kind="collective-permute", axes=("dp",),
+                           dtypes=("f32",), shapes=((1024,),),
+                           bytes=4096, elems=1024),
+                # reduce over an axis no role names
+                Collective(kind="all-reduce", axes=("pipe",),
+                           dtypes=("f32",), shapes=((1024,),),
+                           bytes=4096, elems=1024),
+            ),
+        )
+        findings = L.lint_unplanned_reshard(fake)
+        assert sorted(f.detail["kind"] for f in findings) == [
+            "all-reduce", "collective-permute",
+        ]
+
+    def test_minor_and_unrecognized_axes_are_skipped(self):
+        from tpu_dist.analysis.plan import Collective, CollectivePlan
+
+        base = canonical_program("engine_dp")
+        fake = AnalysisProgram(
+            name="quiet", fn=base.fn, args=base.args, mesh=base.mesh,
+            built=base.built,
+        )
+        fake._cache["plan"] = CollectivePlan(
+            name="quiet", mesh_axes={"dp": N},
+            collectives=(
+                # scalar plumbing: minor, never judged
+                Collective(kind="collective-permute", axes=("dp",),
+                           dtypes=("f32",), shapes=((1,),),
+                           bytes=4, elems=1),
+                # sub-ring groups the mesh index could not name
+                Collective(kind="all-gather", axes=None,
+                           dtypes=("f32",), shapes=((1024,),),
+                           bytes=4096, elems=1024),
+            ),
+        )
+        assert L.lint_unplanned_reshard(fake) == []
+
+    def test_non_engine_programs_are_skipped(self):
+        # no rule-set context: the pipeline engine's rings are planned
+        # by the schedule, not a rule set
+        assert L.lint_unplanned_reshard(
+            canonical_program("pipeline_1f1b")) == []
+
+
 class TestReusedKeyLint:
     def test_reused_key_fires(self):
         def bad(k):

@@ -1,0 +1,583 @@
+"""Static cost model + auto-sharding advisor: the α–β fit must
+round-trip its own calibration data, bubble prediction must agree with
+the schedule table under uniform costs, the advisor must rank
+deterministically and prune on memory, the shared results loader must
+filter by series/platform, and the `advice`/`costcheck` events must
+validate against the schema."""
+
+import json
+import os
+
+import pytest
+
+import jax
+
+from tpu_dist import parallel
+from tpu_dist.analysis import advisor as adv_mod
+from tpu_dist.analysis import costmodel as cm
+from tpu_dist.observe import attribution as attr_mod
+from tpu_dist.observe import events as ev_mod
+from tpu_dist.observe import results as results_mod
+from tpu_dist.parallel.pipeline import build_schedule
+
+N = 8
+
+
+def _cls(kind, axes, count, payload, t, *, max_elems=None, dtype="f32"):
+    return {
+        "kind": kind,
+        "axes": list(axes) if axes is not None else None,
+        "dtype": dtype,
+        "count": count,
+        "payload_bytes": payload,
+        "max_elems": payload // 4 if max_elems is None else max_elems,
+        "measured_s": t,
+    }
+
+
+def _row(program, classes, *, step=None, compute=None, flops=None,
+         spec_hash="hash0", jax_version=None, platform="cpu"):
+    return {
+        "metric": "attribution",
+        "program": program,
+        "classes": classes,
+        "step_time_s": step,
+        "compute_s": compute,
+        "flops": flops,
+        "spec_hash": spec_hash,
+        "mesh_axes": {"dp": N},
+        "provenance": {
+            "backend": platform,
+            "jax_version": jax_version or jax.__version__,
+        },
+    }
+
+
+# ------------------------------------------------------- results loader
+
+
+class TestResultsLoader:
+    def test_series_and_require_filtering(self, tmp_path):
+        p = tmp_path / "rows.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"metric": "a", "x": 1}) + "\n")
+            fh.write("not json at all\n")
+            fh.write(json.dumps(["not", "an", "object"]) + "\n")
+            fh.write(json.dumps({"metric": "b", "x": 2}) + "\n")
+            fh.write(json.dumps({"metric": "a"}) + "\n")
+        assert len(results_mod.load_rows(str(p))) == 3
+        assert [r["x"] for r in
+                results_mod.load_rows(str(p), series="a",
+                                      require=("x",))] == [1]
+        assert len(results_mod.load_rows(str(p), series=("a", "b"))) == 3
+
+    def test_platform_filter_keeps_unattributed_rows(self, tmp_path):
+        p = tmp_path / "rows.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps(
+                {"metric": "m", "provenance": {"backend": "tpu"}}) + "\n")
+            fh.write(json.dumps(
+                {"metric": "m", "platform": "cpu"}) + "\n")
+            fh.write(json.dumps({"metric": "m"}) + "\n")  # no provenance
+        rows = results_mod.load_rows(str(p), platform="cpu")
+        assert len(rows) == 2  # the tpu row filtered, bare row kept
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert results_mod.load_rows(str(tmp_path / "nope.jsonl")) == []
+
+    def test_latest_by(self):
+        rows = [{"k": "a", "v": 1}, {"k": "b", "v": 2}, {"k": "a", "v": 3}]
+        latest = results_mod.latest_by(rows, key=lambda r: r.get("k"))
+        assert latest["a"]["v"] == 3 and latest["b"]["v"] == 2
+
+    def test_regress_routes_through_shared_loader(self, tmp_path):
+        from tpu_dist.observe import regress
+
+        p = tmp_path / "bench.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"metric": "m", "value": 1.0}) + "\n")
+            fh.write("garbage\n")
+        assert regress.load_rows(str(p)) == results_mod.load_rows(str(p))
+
+    def test_attribution_loaders_filter_by_spec_hash(self, tmp_path):
+        p = tmp_path / "attribution.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps(_row("p1", [], spec_hash="old")) + "\n")
+            fh.write(json.dumps(_row("p1", [], spec_hash="new")) + "\n")
+        assert len(attr_mod.load_attribution_rows(str(p))) == 2
+        only_new = attr_mod.load_attribution_rows(str(p), spec_hash="new")
+        assert len(only_new) == 1 and only_new[0]["spec_hash"] == "new"
+
+
+# ------------------------------------------------------------ fit/predict
+
+
+class TestCostModelFit:
+    def test_two_observations_recover_alpha_beta(self):
+        # time = count*2ms + bytes*1e-6: two observations pin it exactly
+        rows = [
+            _row("a", [_cls("all-reduce", ("dp",), 1, 1000, 0.002 + 1e-3)]),
+            _row("b", [_cls("all-reduce", ("dp",), 2, 4000, 0.004 + 4e-3)]),
+        ]
+        model = cm.fit(rows)
+        term = model.term_for("all-reduce", ("dp",))
+        assert term.n_obs == 2
+        assert term.alpha_s == pytest.approx(0.002, rel=1e-6)
+        assert term.sec_per_byte == pytest.approx(1e-6, rel=1e-6)
+        # reduce-scatter folds into the same class term
+        assert model.term_for("reduce-scatter", ("dp",)) is term
+
+    def test_minor_class_never_defines_bandwidth(self):
+        # a 12-byte scalar reduce must not price a megabyte reduce in
+        # seconds — the seeded failure mode of a naive per-class fit
+        rows = [_row("a", [
+            _cls("all-reduce", ("dp", "fsdp"), 3, 12, 1e-4, max_elems=1),
+            _cls("all-gather", ("dp",), 2, 100_000, 1e-3),
+        ])]
+        model = cm.fit(rows)
+        pred = model.predict_classes([
+            {"kind": "all-reduce", "axes": ["dp", "fsdp"], "count": 3,
+             "payload_bytes": 1_000_000, "max_elems": 250_000},
+        ])
+        # priced at the pooled fallback bandwidth (~1e-8 s/B), not the
+        # scalar class's implied 1e-5 s/B
+        assert pred.step_s < 0.5
+
+    def test_compute_term_has_intercept(self):
+        rows = [
+            _row("small", [], compute=0.0018, flops=5e5),
+            _row("big", [], compute=0.0020, flops=7e6),
+        ]
+        model = cm.fit(rows)
+        assert model.base_s > 0
+        for r in rows:
+            pred = model.base_s + r["flops"] * model.sec_per_flop
+            assert pred == pytest.approx(r["compute_s"], rel=1e-6)
+
+    def test_uncovered_class_reports_coverage(self):
+        model = cm.fit([_row("a", [_cls("all-gather", ("dp",), 1, 100, 1e-3)])])
+        pred = model.predict_classes([
+            {"kind": "all-gather", "axes": ["dp"], "count": 1,
+             "payload_bytes": 100, "max_elems": 25},
+            {"kind": "collective-permute", "axes": ["pipe"], "count": 2,
+             "payload_bytes": 512, "max_elems": 128},
+        ])
+        assert pred.coverage == pytest.approx(0.5)
+        assert pred.wire_bytes == 612
+
+    def test_summary_roundtrip(self):
+        rows = [_row("a", [_cls("all-reduce", ("dp",), 1, 1000, 1e-3)],
+                     compute=1e-3, flops=1e6)]
+        model = cm.fit(rows, platform="cpu")
+        back = cm.CostModel.from_summary(model.summary())
+        assert back.sec_per_flop == model.sec_per_flop
+        assert back.base_s == model.base_s
+        t1 = back.term_for("all-reduce", ("dp",))
+        t2 = model.term_for("all-reduce", ("dp",))
+        assert (t1.alpha_s, t1.sec_per_byte) == (t2.alpha_s, t2.sec_per_byte)
+
+
+# ------------------------------------------------------------ calibration
+
+
+class TestCalibration:
+    def _rows(self):
+        classes = [
+            _cls("all-reduce", ("dp",), 5, 150_000, 0.0006),
+            _cls("all-gather", ("fsdp",), 3, 38_000, 0.0003),
+        ]
+        return [_row(
+            "prog", classes,
+            step=0.0009 + 0.002, compute=0.002, flops=5e5,
+        )]
+
+    def test_roundtrip_within_tight_tolerance(self):
+        model, verdicts = cm.calibration_check(
+            self._rows(), tolerance=0.01, jax_version=jax.__version__
+        )
+        assert [v["status"] for v in verdicts] == ["ok"]
+        assert verdicts[0]["error"] == pytest.approx(0.0, abs=1e-3)
+
+    def test_violation_fires(self):
+        rows = self._rows()
+        rows[0]["step_time_s"] *= 10
+        _, verdicts = cm.calibration_check(rows, tolerance=0.35)
+        assert verdicts[0]["status"] == "violation"
+
+    def test_version_skew_is_waived(self):
+        rows = self._rows()
+        rows[0]["step_time_s"] *= 10  # would violate, but...
+        _, verdicts = cm.calibration_check(
+            rows, tolerance=0.35, jax_version="9.9.9"
+        )
+        assert verdicts[0]["status"] == "skew"
+
+    def test_stale_spec_hash_rows_are_excluded(self):
+        stale = self._rows()[0]
+        stale["spec_hash"] = "stale"
+        stale["classes"] = [
+            _cls("all-reduce", ("dp",), 5, 150_000, 5.0)  # poisoned
+        ]
+        fresh = self._rows()[0]
+        sel = cm.select_calibration_rows([stale, fresh])
+        assert sel["prog"] == [fresh]
+
+    def test_plan_only_row_is_no_step(self):
+        rows = [_row("planonly", [_cls("all-reduce", ("dp",), 1, 10, 1e-4)])]
+        _, verdicts = cm.calibration_check(rows, tolerance=0.35)
+        assert verdicts[0]["status"] == "no-step"
+
+    def test_blessed_tolerance_roundtrip(self, tmp_path):
+        assert cm.load_blessed_tolerance(str(tmp_path)) is None
+        cm.save_blessed_tolerance(str(tmp_path), 0.42)
+        assert cm.load_blessed_tolerance(str(tmp_path)) == 0.42
+
+    def test_repo_tolerance_is_blessed(self):
+        goldens = os.path.join(os.path.dirname(__file__), "goldens")
+        assert cm.load_blessed_tolerance(goldens) is not None
+
+
+class TestCostcheckCli:
+    def _run(self, tmp_path, rows, argv=()):
+        from tpu_dist.analysis import advise as advise_cli
+
+        p = tmp_path / "attribution.jsonl"
+        with open(p, "w") as fh:
+            for r in rows:
+                fh.write(json.dumps(r) + "\n")
+        goldens = tmp_path / "goldens"
+        os.makedirs(goldens, exist_ok=True)
+        cm.save_blessed_tolerance(str(goldens), 0.35)
+        return advise_cli.main([
+            "--costcheck", "--path", str(p), "--goldens", str(goldens),
+            "-q", *argv,
+        ])
+
+    def test_ok_exits_zero(self, tmp_path):
+        rows = [_row("p", [_cls("all-reduce", ("dp",), 2, 1000, 1e-3)],
+                     step=3e-3, compute=2e-3, flops=1e6)]
+        assert self._run(tmp_path, rows) == 0
+
+    def test_violation_exits_one(self, tmp_path):
+        rows = [_row("p", [_cls("all-reduce", ("dp",), 2, 1000, 1e-3)],
+                     step=3e-2, compute=2e-3, flops=1e6)]
+        assert self._run(tmp_path, rows) == 1
+
+    def test_skew_exits_zero(self, tmp_path):
+        rows = [_row("p", [_cls("all-reduce", ("dp",), 2, 1000, 1e-3)],
+                     step=3e-2, compute=2e-3, flops=1e6,
+                     jax_version="9.9.9")]
+        assert self._run(tmp_path, rows) == 0
+
+    def test_no_rows_exits_zero(self, tmp_path):
+        assert self._run(tmp_path, []) == 0
+
+    def test_costcheck_event_emitted_and_valid(self, tmp_path, monkeypatch):
+        tdir = tmp_path / "telemetry"
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tdir))
+        rows = [_row("p", [_cls("all-reduce", ("dp",), 2, 1000, 1e-3)],
+                     step=3e-3, compute=2e-3, flops=1e6)]
+        assert self._run(tmp_path, rows) == 0
+        recs = [r for r in ev_mod.read_events(str(tdir))
+                if r.get("event") == "costcheck"]
+        assert recs and recs[-1]["status"] == "ok"
+        assert ev_mod.validate_record(recs[-1]) == []
+
+
+# --------------------------------------------------------------- bubbles
+
+
+class TestBubblePrediction:
+    @pytest.mark.parametrize("kind,n,M,v", [
+        ("gpipe", 4, 8, 1),
+        ("1f1b", 4, 8, 1),
+        ("1f1b", 3, 6, 1),
+        ("interleaved_1f1b", 4, 8, 2),
+    ])
+    def test_uniform_costs_match_table_bubble(self, kind, n, M, v):
+        sched = build_schedule(n, M, v, kind)
+        pred = cm.predict_bubble_fraction(sched, 1.0, 1.0)
+        assert pred == pytest.approx(sched.bubble_fraction(), abs=1e-9)
+
+    def test_unbalanced_costs_raise_the_bubble(self):
+        sched = build_schedule(4, 8, 1, "1f1b")
+        uniform = cm.predict_bubble_fraction(sched, 1.0, 1.0)
+        heavy = cm.predict_bubble_fraction(
+            sched, [1, 1, 1, 4.0], [1, 1, 1, 4.0]
+        )
+        assert heavy > uniform
+        assert 0.0 <= heavy < 1.0
+
+    def test_per_stage_length_validated(self):
+        sched = build_schedule(4, 8, 1, "1f1b")
+        with pytest.raises(ValueError, match="per-global-stage"):
+            cm.predict_bubble_fraction(sched, [1, 1], 1.0)
+        with pytest.raises(ValueError, match="nonnegative"):
+            cm.predict_bubble_fraction(sched, [1, 1, 1, -1], 1.0)
+
+    def test_measured_table_feeds_prediction(self):
+        rows = [
+            {"model": "m", "stage": s, "n_stages": 3,
+             "fwd_s": 0.001 * (s + 1), "bwd_s": 0.002 * (s + 1),
+             "spec_hash": "h"}
+            for s in range(3)
+        ]
+        table = cm.stage_table_from_rows(rows)
+        assert table["n_stages"] == 3
+        sched = build_schedule(3, 6, 1, "1f1b")
+        b = cm.predict_bubble_fraction(
+            sched, table["fwd_s"], table["bwd_s"]
+        )
+        assert 0.0 < b < 1.0
+
+    def test_stage_table_picks_latest_complete_group(self):
+        old = [{"model": "m", "stage": s, "n_stages": 2, "fwd_s": 1.0,
+                "bwd_s": 1.0, "spec_hash": "old"} for s in range(2)]
+        incomplete = [{"model": "m", "stage": 0, "n_stages": 4,
+                       "fwd_s": 9.0, "bwd_s": 9.0, "spec_hash": "cut"}]
+        table = cm.stage_table_from_rows(old + incomplete)
+        assert table["spec_hash"] == "old" and table["n_stages"] == 2
+        assert cm.stage_table_from_rows([]) is None
+
+
+# --------------------------------------------------------------- advisor
+
+
+def _fake_candidate(spec, compress, step_s, *, peak=1000, pruned=None):
+    c = adv_mod.Candidate(spec=spec, compress=compress, rule_set=spec,
+                          peak_bytes=peak, pruned=pruned)
+    if pruned is None:
+        c.predicted = cm.Prediction(
+            program=c.label, step_s=step_s, compute_s=None,
+            collective_s=step_s, wire_bytes=0,
+        )
+    return c
+
+
+class TestAdvisorRanking:
+    def test_rank_is_order_insensitive_and_stable(self):
+        cands = [
+            _fake_candidate("dp=8", "off", 3e-3),
+            _fake_candidate("fsdp=8", "off", 1e-3),
+            _fake_candidate("dp=2,fsdp=4", "int8", 2e-3),
+            _fake_candidate("zero1:dp=8", "off", 9e-3, pruned="memory: x"),
+        ]
+        a = [c.label for c in adv_mod.rank_candidates(cands)]
+        b = [c.label for c in adv_mod.rank_candidates(cands[::-1])]
+        assert a == b == ["fsdp=8/off", "dp=2,fsdp=4/int8", "dp=8/off"]
+
+    def test_ties_break_on_spec_then_compress(self):
+        cands = [
+            _fake_candidate("b=8", "off", 1e-3),
+            _fake_candidate("a=8", "off", 1e-3),
+            _fake_candidate("a=8", "int8", 1e-3),
+        ]
+        assert [c.label for c in adv_mod.rank_candidates(cands)] == [
+            "a=8/int8", "a=8/off", "b=8/off",
+        ]
+
+    def test_enumerate_mesh_axes(self):
+        specs = parallel.enumerate_mesh_axes(8, tp=True)
+        assert specs[0] == "dp=8"
+        assert "zero1:dp=8" in specs and "fsdp=8" in specs
+        assert "dp=2,fsdp=4" in specs and "dp=4,tp=2" in specs
+        # every spec must resolve on a mesh of its own shape
+        for spec in specs:
+            mesh = parallel.build_mesh(spec, platform="cpu")
+            rules = parallel.resolve_rules(spec, mesh)
+            assert rules.data_axes
+        assert parallel.enumerate_mesh_axes(1) == ["dp=1"]
+        assert parallel.enumerate_mesh_axes(8) == \
+            parallel.enumerate_mesh_axes(8)  # deterministic
+
+    def test_rank_agreement_tolerance_band(self):
+        report = adv_mod.AdviceReport(model="m", chips=8, bytes_limit=None)
+        report.candidates = [
+            _fake_candidate("dp=8", "off", 1e-3),
+            _fake_candidate("fsdp=8", "off", 2e-3),
+        ]
+        measured = {"dp=8": 96.0, "fsdp=8": 100.0}
+        out = adv_mod.rank_agreement(report, measured, tolerance=0.15)
+        assert out["checked"] and out["agree"]  # within the band
+        out = adv_mod.rank_agreement(report, measured, tolerance=0.01)
+        assert out["agree"] is False  # band tightened: dp=8 is not best
+        out = adv_mod.rank_agreement(report, {}, tolerance=0.15)
+        assert out["checked"] is False
+
+
+@pytest.fixture(scope="module")
+def mlp_report():
+    """One real advise run over two MLP candidates (two engine
+    compiles, shared by the tests below)."""
+    rows = [_row("seed", [_cls("all-reduce", ("dp",), 5, 150_000, 6e-4)],
+                 step=2.4e-3, compute=1.8e-3, flops=5e5)]
+    return adv_mod.advise(
+        model="mlp", chips=N, compress_modes=("off",),
+        specs=[f"dp={N}", f"fsdp={N}"], attribution_rows=rows,
+    )
+
+
+class TestAdvisorReal:
+    def test_two_candidates_ranked(self, mlp_report):
+        ranked = mlp_report.ranked()
+        assert len(ranked) == 2
+        assert {c.spec for c in ranked} == {f"dp={N}", f"fsdp={N}"}
+        for c in ranked:
+            assert c.predicted.step_s > 0
+            assert c.wire_bytes > 0
+            assert c.peak_bytes is not None and c.peak_bytes > 0
+            assert c.flops and c.flops > 0
+
+    def test_deterministic_reranking(self, mlp_report):
+        # the ranking rule re-applied to the same candidates in any
+        # order reproduces AdviceReport.ranked exactly
+        want = [c.label for c in mlp_report.ranked()]
+        got = [c.label for c in
+               adv_mod.rank_candidates(mlp_report.candidates[::-1])]
+        assert got == want
+
+    def test_memory_pruning_under_injected_limit(self, mlp_report):
+        peaks = {c.spec: c.peak_bytes for c in mlp_report.ranked()}
+        lo, hi = sorted(peaks.values())
+        assert lo < hi  # fsdp shards state: strictly smaller plan peak
+        limit = (lo + hi) // 2
+        rows = [_row("seed", [_cls("all-reduce", ("dp",), 5, 150_000,
+                                   6e-4)],
+                     step=2.4e-3, compute=1.8e-3, flops=5e5)]
+        report = adv_mod.advise(
+            model="mlp", chips=N, compress_modes=("off",),
+            specs=[f"dp={N}", f"fsdp={N}"], attribution_rows=rows,
+            bytes_limit=limit,
+        )
+        ranked = report.ranked()
+        pruned = report.pruned()
+        assert len(ranked) == 1 and len(pruned) == 1
+        assert pruned[0].peak_bytes == hi
+        assert "memory" in pruned[0].pruned
+        assert pruned[0] is not report.best
+
+    def test_fsdp_peak_below_dp_peak(self, mlp_report):
+        by_spec = {c.spec: c for c in mlp_report.ranked()}
+        assert by_spec[f"fsdp={N}"].peak_bytes < \
+            by_spec[f"dp={N}"].peak_bytes
+
+    def test_refused_combo_is_recorded_not_raised(self):
+        # no data axis: parse_mesh_axes refuses — the advisor must
+        # record the refusal as a pruned candidate, not crash
+        report = adv_mod.advise(
+            model="mlp", chips=N, compress_modes=("off",),
+            specs=["tp=8"], attribution_rows=[],
+        )
+        assert report.ranked() == []
+        assert report.candidates[0].pruned.startswith("refused:")
+
+    def test_advice_event_fields_validate(self, mlp_report, tmp_path,
+                                          monkeypatch):
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tmp_path))
+        rec = ev_mod.from_env().emit("advice", **mlp_report.event_fields())
+        assert ev_mod.validate_record(rec) == []
+        bad = {k: v for k, v in rec.items() if k != "best"}
+        assert any("best" in e for e in ev_mod.validate_record(bad))
+
+    def test_tpu_top_renders_advise_line(self, mlp_report, tmp_path,
+                                         monkeypatch):
+        import importlib.util
+
+        monkeypatch.setenv("TPU_DIST_TELEMETRY", str(tmp_path))
+        fields = mlp_report.event_fields()
+        fields["agreement"] = {"checked": True, "agree": True,
+                               "measured_best": "dp"}
+        ev_mod.from_env().emit("advice", **fields)
+        ev_mod.from_env().emit(
+            "costcheck", programs=1, tolerance=0.35, status="ok",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "tpu_top", os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools", "tpu_top.py",
+            ),
+        )
+        tpu_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tpu_top)
+        out = tpu_top.render(tpu_top.collect(str(tmp_path)))
+        assert "advise" in out and "AGREE" in out
+        assert "costcheck" in out  # NOTABLE renders the gate status
+
+
+# ------------------------------------------------- stage-cost provenance
+
+
+class TestStageCostProvenance:
+    def _tiny_stages(self):
+        import jax.numpy as jnp
+
+        k = jax.random.key(0)
+        p = {"w": jax.random.normal(k, (4, 4))}
+
+        def mid(params, x):
+            return jnp.tanh(x @ params["w"])
+
+        def last(params, x):
+            return jnp.mean(mid(params, x) ** 2)
+
+        x0 = jnp.ones((2, 4))
+        return [mid, last], [p, p], x0
+
+    def test_rows_carry_spec_hash_and_mesh_shape(self):
+        fns, params, x0 = self._tiny_stages()
+        rows = attr_mod.measure_stage_costs(
+            fns, params, x0, iters=1, warmup=1, model="tiny"
+        )
+        assert len(rows) == 2
+        hashes = {r["spec_hash"] for r in rows}
+        assert len(hashes) == 1 and all(r["mesh_shape"] == {"pipe": 2}
+                                        for r in rows)
+        # a different structure hashes differently
+        rows2 = attr_mod.measure_stage_costs(
+            fns, params, x0, iters=1, warmup=1, model="other"
+        )
+        assert rows2[0]["spec_hash"] not in hashes
+
+    def test_persist_and_shared_loader_roundtrip(self, tmp_path):
+        fns, params, x0 = self._tiny_stages()
+        rows = attr_mod.measure_stage_costs(
+            fns, params, x0, iters=1, warmup=1, model="tiny"
+        )
+        attr_mod.persist_stage_costs(rows, root=str(tmp_path))
+        back = attr_mod.load_stage_cost_rows(
+            str(tmp_path / "stage_costs.jsonl"),
+            spec_hash=rows[0]["spec_hash"],
+        )
+        assert len(back) == 2
+        table = cm.stage_table_from_rows(back)
+        assert table["n_stages"] == 2 and table["model"] == "tiny"
+
+
+# --------------------------------------------------- report provenance
+
+
+class TestAttributionProvenance:
+    def test_report_roundtrips_spec_hash_and_flops(self):
+        rep = attr_mod.AttributionReport(
+            program="p", spec_hash="abc", flops=123.0,
+        )
+        back = attr_mod.AttributionReport.from_dict(rep.to_dict())
+        assert back.spec_hash == "abc" and back.flops == 123.0
+
+    def test_plan_spec_hash_tracks_structure(self):
+        from tpu_dist.analysis.plan import Collective, CollectivePlan
+
+        def plan(nbytes):
+            return CollectivePlan(
+                name="p", mesh_axes={"dp": 2},
+                collectives=(Collective(
+                    kind="all-reduce", axes=("dp",), dtypes=("f32",),
+                    shapes=((nbytes // 4,),), bytes=nbytes,
+                    elems=nbytes // 4,
+                ),),
+            )
+
+        assert attr_mod.plan_spec_hash(plan(64)) == \
+            attr_mod.plan_spec_hash(plan(64))
+        assert attr_mod.plan_spec_hash(plan(64)) != \
+            attr_mod.plan_spec_hash(plan(128))

@@ -31,7 +31,7 @@ from tpu_dist.observe import flightrec as fr_mod  # noqa: E402
 from tpu_dist.observe import heartbeat as hb_mod  # noqa: E402
 
 NOTABLE = ("retry", "chaos", "stall", "preempt", "checkpoint", "warning",
-           "flight_dump", "oom")
+           "flight_dump", "oom", "costcheck")
 
 
 def _fmt(value, spec: str = "", none: str = "--") -> str:
@@ -92,6 +92,7 @@ def empty_state(dirpath: str) -> dict:
         "beats": {},
         "serve": None,     # last decode_step record (serving runs)
         "analysis": None,  # last static-analyzer summary (make analyze)
+        "advise": None,    # last auto-sharding advice (make advise)
         "attr": None,      # last attribution report (make attribute)
         "mem": None,       # last memory event (observe.memory sampler)
         "flight": None,    # merged flight-recorder divergence, if dumps exist
@@ -115,6 +116,8 @@ def update(state: dict, records: list) -> dict:
             state["serve"] = rec
         elif kind == "analysis":
             state["analysis"] = rec
+        elif kind == "advice":
+            state["advise"] = rec
         elif kind == "attribution":
             state["attr"] = rec
         elif kind == "memory":
@@ -234,6 +237,32 @@ def render(state: dict, *, now: float | None = None, recent: int = 8) -> str:
             f"  findings {f_s}"
             f"  goldens {an.get('golden') or '--'}"
             f"  ({_age(an.get('time'), now)})"
+        )
+
+    ad = state.get("advise")
+    if ad:
+        # auto-sharding advisor (make advise): top-ranked configuration
+        # + predicted step time, with the measured-trajectory agreement
+        # verdict and the current measured step for contrast
+        best = ad.get("best") or {}
+        agree = ad.get("agreement") or {}
+        cur = None
+        att = state.get("attr")
+        if att and att.get("step_time"):
+            cur = f"  current {att['step_time'] * 1e3:.2f}ms (measured)"
+        verdict = ""
+        if agree.get("checked"):
+            verdict = (
+                f"  vs measured-best {agree.get('measured_best')!r} "
+                + ("AGREE" if agree.get("agree") else "DISAGREE")
+            )
+        lines.append(
+            f"advise  best {best.get('spec')}/{best.get('compress')}"
+            f"  predicted {_fmt((best.get('predicted_step_s') or 0) * 1e3, '.2f')}ms"
+            f"  wire {_fmt((best.get('predicted_wire_bytes') or 0) / 1e3, ',.0f')}kB"
+            + (cur or "")
+            + verdict
+            + f"  ({_age(ad.get('time'), now)})"
         )
 
     at = state.get("attr")

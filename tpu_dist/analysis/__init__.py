@@ -15,10 +15,19 @@ Three layers (see docs/analysis.md):
   → `MemoryPlan` from XLA's compiled memory sections + rule-engine
   state attribution) and the peak-HBM golden gate under
   ``tests/goldens/memory/``.
+- `costmodel`: the α–β static cost model fitted from persisted
+  attribution rows — predicted step time / wire bytes for any
+  `CollectivePlan`, predicted pipeline bubbles from measured stage
+  costs, and the ``make costcheck`` calibration gate.
+- `advisor`: the auto-sharding advisor — enumerate (mesh_axes,
+  compress) candidates, prune on the memory plan, rank by predicted
+  step time.
 
 CLIs: ``python -m tpu_dist.analysis`` (``make analyze`` /
-``make analyze-bless``) and ``python -m tpu_dist.analysis.memory``
-(``make memcheck`` / ``make memcheck-bless``).
+``make analyze-bless``), ``python -m tpu_dist.analysis.memory``
+(``make memcheck`` / ``make memcheck-bless``), and ``python -m
+tpu_dist.analysis.advise`` (``make advise`` / ``make advise-smoke`` /
+``make costcheck``).
 """
 
 from tpu_dist.analysis.lints import (
@@ -53,11 +62,14 @@ from tpu_dist.analysis.programs import (
     canonical_program,
     canonical_programs,
 )
+from tpu_dist.analysis import advisor, costmodel
 
 __all__ = [
     "ALL_LINTS",
     "AnalysisProgram",
     "CANONICAL",
+    "advisor",
+    "costmodel",
     "Collective",
     "CollectivePlan",
     "Finding",

@@ -1,0 +1,406 @@
+"""Auto-sharding advisor — rank (mesh_axes, rules, compress) statically.
+
+Choosing a partition configuration has been trial-and-run: build the
+mesh, train, read the bench.  Every ingredient of a STATIC answer now
+exists — `parallel.enumerate_mesh_axes` names the candidate rule sets a
+chip count supports, the partition engine compiles any of them,
+`analysis.plan` extracts the collective plan (payload bytes per class),
+`analysis.memory` extracts the HBM plan (peak bytes per rank), XLA cost
+analysis prices the compute, and `analysis.costmodel` turns persisted
+attribution measurements into α–β time predictions.  The advisor is
+the loop that composes them:
+
+1. enumerate candidate ``(mesh_axes spec, compress)`` configurations
+   for a model spec + chip count (`parallel.enumerate_mesh_axes` ×
+   compress modes);
+2. compile each candidate's engine step and extract its collective +
+   memory plans (compile-time only — nothing executes);
+3. prune candidates whose `MemoryPlan.peak_bytes` exceeds the device
+   ``bytes_limit`` (they would OOM — predicted speed is irrelevant);
+4. rank survivors by predicted step time under the fitted `CostModel`
+   and report predicted wire bytes, peak HBM, and per-class coverage.
+
+``python -m tpu_dist.analysis.advise`` (``make advise``) drives this
+end to end, checks rank agreement against the measured ``bench-mesh``
+trajectory, and emits the validated ``advice`` telemetry event.
+Deterministic by construction: plan extraction is retrace-stable
+(tested), enumeration order is fixed, and ties break on the spec
+string — same inputs, same ranking.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tpu_dist.analysis import costmodel as cost_mod
+
+# the small-bucket compress spec the canonical programs use — tiny
+# models must still ship several buckets for the plan to be structural
+COMPRESS_SPEC = "int8,bucket_bytes=32768,block=64"
+
+
+@dataclass
+class Candidate:
+    """One enumerated configuration and everything the advisor learned
+    about it statically."""
+
+    spec: str                  # mesh_axes, e.g. "dp=2,fsdp=4"
+    compress: str              # "off" | wire name ("int8", ...)
+    rule_set: str | None = None
+    mesh_axes: dict = field(default_factory=dict)
+    plan_rows: list = field(default_factory=list)
+    wire_bytes: int | None = None
+    peak_bytes: int | None = None
+    state_bytes: int | None = None   # params+opt resident per rank
+    flops: float | None = None
+    predicted: cost_mod.Prediction | None = None
+    pruned: str | None = None  # non-None = out of the ranking (reason)
+
+    @property
+    def label(self) -> str:
+        return f"{self.spec}/{self.compress}"
+
+    def summary(self) -> dict:
+        return {
+            "spec": self.spec,
+            "compress": self.compress,
+            "rule_set": self.rule_set,
+            "predicted_step_s": (
+                self.predicted.step_s if self.predicted else None
+            ),
+            "predicted_wire_bytes": self.wire_bytes,
+            "peak_bytes": self.peak_bytes,
+            "state_bytes": self.state_bytes,
+            "coverage": (
+                self.predicted.coverage if self.predicted else None
+            ),
+            "pruned": self.pruned,
+        }
+
+
+@dataclass
+class AdviceReport:
+    """The advisor's output: every candidate, ranked survivors first."""
+
+    model: str
+    chips: int
+    bytes_limit: int | None
+    candidates: list = field(default_factory=list)
+    cost_rows: int = 0         # attribution rows the model was fit on
+    platform: str | None = None
+
+    def ranked(self) -> list[Candidate]:
+        """Survivors by predicted step time (spec/compress tie-break —
+        the determinism contract, `rank_candidates`)."""
+        return rank_candidates(self.candidates)
+
+    def pruned(self) -> list[Candidate]:
+        return [c for c in self.candidates if c.pruned is not None]
+
+    @property
+    def best(self) -> Candidate | None:
+        ranked = self.ranked()
+        return ranked[0] if ranked else None
+
+    def summary_lines(self) -> list[str]:
+        lines = [
+            f"advise: model {self.model} @ {self.chips} chips"
+            + (f"  bytes_limit {self.bytes_limit:,}"
+               if self.bytes_limit else "")
+            + f"  (cost model: {self.cost_rows} attribution rows)"
+        ]
+        for i, c in enumerate(self.ranked()):
+            p = c.predicted
+            lines.append(
+                f"  #{i + 1} {c.label:<18} rules {c.rule_set or '?':<10}"
+                f" step {p.step_s * 1e3:8.3f}ms"
+                f"  wire {(c.wire_bytes or 0) / 1e3:9.1f}kB"
+                + (f"  peak {c.peak_bytes / 1e6:7.1f}MB"
+                   if c.peak_bytes is not None else "")
+                + (f"  coverage {p.coverage:.0%}" if p.coverage < 1 else "")
+            )
+        for c in self.pruned():
+            lines.append(f"  -- {c.label:<18} PRUNED: {c.pruned}")
+        return lines
+
+    def event_fields(self) -> dict:
+        """The ``advice`` telemetry event payload (validated schema)."""
+        best = self.best
+        return {
+            "model": self.model,
+            "chips": self.chips,
+            "best": best.summary() if best is not None else None,
+            "ranking": [c.summary() for c in self.ranked()],
+            "pruned": [c.summary() for c in self.pruned()],
+            "bytes_limit": self.bytes_limit,
+            "cost_rows": self.cost_rows,
+        }
+
+
+# ------------------------------------------------------------ model specs
+
+
+def _mlp_builder():
+    """The analyzer's tiny MLP (shared with `programs._mlp_loss_pair`
+    so plans stay comparable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist import models
+    from tpu_dist.analysis.programs import _mlp_loss_pair
+
+    params, _, loss_fn, _ = _mlp_loss_pair()
+
+    def batch(n_chips):
+        return (
+            jnp.zeros((2 * n_chips,) + models.IN_SHAPE, jnp.float32),
+            jnp.zeros((2 * n_chips,), jnp.int32),
+        )
+
+    return params, loss_fn, batch
+
+
+def _lm_builder():
+    """A small `TransformerLM` — the bench-mesh workload's shape at
+    advisor scale (structure, not width, is what plans depend on), and
+    the Megatron tp vocabulary has names to bind to."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.models.transformer_lm import TransformerLM, lm_loss
+
+    lm = TransformerLM(vocab=256, dim=64, depth=2, heads=4, max_seq=64)
+    params, _ = lm.init(jax.random.key(0))
+
+    def loss_fn(p, tokens, key):
+        logits, _ = lm.apply(p, {}, tokens)
+        return lm_loss(logits.astype(jnp.float32), tokens), {}
+
+    def batch(n_chips):
+        return jnp.zeros((2 * n_chips, 32), jnp.int32)
+
+    return params, loss_fn, batch
+
+
+MODELS = {
+    "mlp": {"builder": _mlp_builder, "tp": False},
+    "lm": {"builder": _lm_builder, "tp": True},
+}
+
+
+def build_candidate_program(
+    model: str, spec: str, compress: str = "off", *, chips: int | None = None
+):
+    """Compile one candidate configuration into an
+    `analysis.programs.AnalysisProgram` (CPU-sim mesh — plans are
+    compile-time artifacts).  Raises whatever the engine raises when
+    the configuration is invalid (the advisor records it as pruned)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from tpu_dist import parallel, train
+    from tpu_dist.analysis.programs import AnalysisProgram
+
+    if model not in MODELS:
+        raise ValueError(f"unknown advisor model {model!r}; one of "
+                         f"{sorted(MODELS)}")
+    params, loss_fn, batch_fn = MODELS[model]["builder"]()
+    mesh = parallel.build_mesh(spec, platform="cpu")
+    rules = parallel.resolve_rules(spec, mesh)
+    ccfg = COMPRESS_SPEC if compress not in (None, "off") else None
+    built = parallel.make_partitioned_train_step(
+        loss_fn, train.sgd(0.05, momentum=0.5), mesh, params, rules,
+        donate=True, compress=ccfg,
+    )
+    sh = NamedSharding(mesh, rules.batch_spec())
+    batch = jax.tree.map(
+        lambda x: jax.device_put(x, sh), batch_fn(int(mesh.devices.size))
+    )
+    return AnalysisProgram(
+        name=f"advise:{model}@{spec}/{compress}",
+        fn=built.step,
+        args=(built.params, built.opt_state, batch, jax.random.key(0)),
+        mesh=mesh,
+        built=built,
+        compress=built.compress,
+        expect_donation=True,
+        params=params,
+        tags=("advise", "engine"),
+    )
+
+
+def _inspect(model: str, spec: str, compress: str) -> Candidate:
+    """Everything the advisor learns about one candidate from ONE
+    compile: collective plan, memory plan, resident state, FLOPs."""
+    from tpu_dist import parallel
+    from tpu_dist.analysis import memory as mem_mod
+    from tpu_dist.train import flops as flops_mod
+
+    cand = Candidate(spec=spec, compress=compress)
+    prog = build_candidate_program(model, spec, compress)
+    plan = prog.plan
+    cand.rule_set = prog.built.ruleset.name
+    cand.mesh_axes = dict(plan.mesh_axes)
+    cand.plan_rows = plan.rows()
+    cand.wire_bytes = plan.total_bytes(major_only=False)
+    mplan = mem_mod.extract_memory_plan(prog)
+    cand.peak_bytes = mplan.peak_bytes
+    dev0 = prog.mesh.devices.flat[0]
+    cand.state_bytes = (
+        parallel.per_device_bytes(prog.built.params, dev0)
+        + parallel.per_device_bytes(prog.built.opt_state, dev0)
+    )
+    cand.flops = flops_mod.xla_flops(prog.fn, *prog.args)
+    return cand
+
+
+def fit_default_cost_model(
+    attribution_rows: list[dict] | None = None,
+) -> cost_mod.CostModel:
+    """The one default fitting path (shared by `advise` and the CLI):
+    per-program spec-hash-matched calibration rows
+    (`costmodel.select_calibration_rows`) fitted with the platform
+    provenance of the latest recording."""
+    from tpu_dist.observe import results as results_mod
+
+    if attribution_rows is None:
+        from tpu_dist.observe import attribution as attr_mod
+
+        attribution_rows = attr_mod.load_attribution_rows()
+    per_prog = cost_mod.select_calibration_rows(attribution_rows)
+    fit_rows = [r for rs in per_prog.values() for r in rs]
+    platform = (
+        results_mod.row_platform(attribution_rows[-1])
+        if attribution_rows else None
+    )
+    return cost_mod.fit(fit_rows, platform=platform)
+
+
+def advise(
+    model: str = "lm",
+    chips: int = 8,
+    *,
+    compress_modes: tuple = ("off", "int8"),
+    specs: list[str] | None = None,
+    bytes_limit: int | None = None,
+    cost_model: cost_mod.CostModel | None = None,
+    attribution_rows: list[dict] | None = None,
+) -> AdviceReport:
+    """Rank every candidate configuration for ``model`` at ``chips``
+    chips, entirely statically.  ``bytes_limit`` prunes candidates
+    whose memory-plan peak would not fit (None = no pruning — CPU-sim
+    has no tracked limit; pass the target chip's HBM when advising for
+    real hardware).  ``cost_model`` defaults to a fit over the
+    persisted attribution rows (`observe.attribution
+    .load_attribution_rows`)."""
+    from tpu_dist import parallel
+
+    if cost_model is None:
+        cost_model = fit_default_cost_model(attribution_rows)
+    if specs is None:
+        specs = parallel.enumerate_mesh_axes(
+            chips, tp=MODELS.get(model, {}).get("tp", False)
+        )
+    report = AdviceReport(
+        model=model, chips=chips, bytes_limit=bytes_limit,
+        cost_rows=cost_model.n_rows, platform=cost_model.platform,
+    )
+    for spec in specs:
+        for mode in compress_modes:
+            try:
+                cand = _inspect(model, spec, mode)
+            except Exception as e:  # engine refusal / invalid combo
+                cand = Candidate(
+                    spec=spec, compress=mode,
+                    pruned=f"refused: {type(e).__name__}: {e}",
+                )
+                report.candidates.append(cand)
+                continue
+            if (bytes_limit is not None and cand.peak_bytes is not None
+                    and cand.peak_bytes > bytes_limit):
+                cand.pruned = (
+                    f"memory: plan peak {cand.peak_bytes:,} B exceeds "
+                    f"bytes_limit {bytes_limit:,} B"
+                )
+            else:
+                cand.predicted = cost_model.predict_classes(
+                    cand.plan_rows, flops=cand.flops, program=cand.label
+                )
+            report.candidates.append(cand)
+    return report
+
+
+def rank_candidates(candidates: list[Candidate]) -> list[Candidate]:
+    """The advisor's ranking rule as a standalone, order-insensitive
+    function: survivors by (predicted step time, spec, compress) — the
+    determinism contract `AdviceReport.ranked` implements and tests
+    exercise directly."""
+    live = [
+        c for c in candidates
+        if c.pruned is None and c.predicted is not None
+    ]
+    return sorted(
+        live, key=lambda c: (c.predicted.step_s, c.spec, c.compress)
+    )
+
+
+# ------------------------------------------------- measured-rank agreement
+
+
+def measured_rule_ranking(
+    bench_rows: list[dict], *, compress: str = "off"
+) -> dict[str, float]:
+    """Median measured tokens/s per rule set from persisted
+    ``bench-mesh`` rows (``bench_runs.jsonl``, metric
+    ``mesh_rule_set``) — the trajectory the advisor's ranking is
+    checked against."""
+    import statistics
+
+    series: dict[str, list[float]] = {}
+    for r in bench_rows:
+        if r.get("metric") != "mesh_rule_set":
+            continue
+        if r.get("compress", "off") != compress:
+            continue
+        tps = r.get("tokens_per_sec") or r.get("value")
+        if r.get("rule_set") and isinstance(tps, (int, float)):
+            series.setdefault(str(r["rule_set"]), []).append(float(tps))
+    return {k: statistics.median(v) for k, v in series.items()}
+
+
+def rank_agreement(
+    report: AdviceReport,
+    measured: dict[str, float],
+    *,
+    tolerance: float = 0.15,
+) -> dict:
+    """Does the advisor's top pick agree with the measured trajectory?
+
+    CPU-sim rule-set throughputs sit within noise of each other (the
+    ROADMAP's standing caveat), so "agreement" is tolerance-banded: the
+    predicted-best rule set's measured median must be within
+    ``tolerance`` of the measured best.  Only candidates with a
+    measured counterpart participate (compress=off rows — the rule-SET
+    choice is what bench-mesh ranks)."""
+    ranked = [
+        c for c in report.ranked()
+        if c.compress == "off" and c.rule_set in measured
+    ]
+    out = {
+        "checked": bool(ranked) and bool(measured),
+        "agree": None,
+        "predicted_best": None,
+        "measured_best": None,
+        "tolerance": tolerance,
+    }
+    if not out["checked"]:
+        return out
+    best = ranked[0]
+    meas_best = max(measured, key=lambda k: measured[k])
+    out["predicted_best"] = best.rule_set
+    out["measured_best"] = meas_best
+    out["agree"] = bool(
+        measured[best.rule_set]
+        >= (1.0 - tolerance) * measured[meas_best]
+    )
+    return out

@@ -1,0 +1,545 @@
+"""Static cost model — predict step time, wire bytes, and bubbles
+without running anything.
+
+The repo extracts a full static description of every program (the
+collective plan with per-class payload bytes, the memory plan, XLA cost
+analysis FLOPs) and persists MEASURED costs (`make attribute`:
+per-(kind, axes, dtype) collective class times in
+``benchmarks/results/attribution.jsonl``, per-pipeline-stage F/B times
+in ``stage_costs.jsonl``) — but until now nothing composed them:
+choosing ``mesh_axes`` / ``partition_rules`` / ``compress`` was
+trial-and-run.  This module is the composition, an α–β (latency +
+inverse-bandwidth) model in the spirit of the characterization
+methodology of arxiv 1810.11112:
+
+- `fit(rows)` fits one `ClassTerm` — ``time = count·α + bytes/β`` —
+  per (kind-class, mesh axes) from the persisted attribution rows,
+  plus a seconds-per-FLOP compute term from the rows' measured
+  ``compute_s`` against their XLA-cost-analysis ``flops``.
+- `CostModel.predict_classes` / `predict_plan` predict the step time
+  and wire bytes of ANY `analysis.plan.CollectivePlan` — including one
+  freshly extracted for a candidate configuration that has never run
+  (`analysis.advisor` is exactly that loop).
+- `predict_bubble_fraction(schedule, fwd_s, bwd_s)` predicts a
+  `parallel.pipeline.build_schedule` table's bubble under MEASURED
+  per-stage costs (``stage_costs.jsonl`` via `stage_table_from_rows`):
+  lockstep ticks run at the slowest active stage's pace, so unbalanced
+  stages stretch every tick they appear in.  With uniform costs this
+  reduces exactly to `Schedule.bubble_fraction()` (tested) — the
+  direct precursor to ROADMAP item 4's cost-weighted schedule
+  generator (arxiv 2412.14374's measured-cost synthesis direction).
+- `calibration_check(rows, tolerance=...)` is the ``make costcheck``
+  gate: fit on the persisted rows, predict each program's own step
+  time back, fail when prediction and measurement disagree past the
+  blessed tolerance — the guard that keeps the advisor's rankings
+  anchored to reality.
+
+Calibration only consumes rows whose ``spec_hash`` provenance matches
+the latest recording for that program (`observe.attribution` stamps
+it), so a row measured before a program's wire structure changed can
+never calibrate the changed one.  Pure data-plane: no jax import on
+the fit/predict path (the bubble predictor needs only the static
+schedule table), so ``make costcheck`` runs without touching a
+backend.
+
+CPU-sim caveat (docs/analysis.md): the fitted β are memcpy
+bandwidths, not interconnect bandwidths — predictions rank
+configurations and gate regressions on CPU; absolute times are only
+meaningful on real chips.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+
+import numpy as np
+
+from tpu_dist.analysis.plan import KIND_CLASS, MINOR_ELEMS
+
+DEFAULT_TOLERANCE = 0.35
+MODEL_VERSION = 1
+
+
+def _class_key(kind: str, axes) -> tuple:
+    """Fit/predict grouping key: (kind-class, axes tuple).  Kind-class
+    folds all-reduce/reduce-scatter into ``reduce`` (the analyzer's
+    lowering-robust granularity); dtype deliberately does NOT split the
+    key — an α–β term is a property of the wire, and payload BYTES
+    already carry the dtype width."""
+    return (
+        KIND_CLASS.get(kind, kind),
+        tuple(axes) if axes is not None else None,
+    )
+
+
+@dataclass
+class ClassTerm:
+    """One fitted α–β term: predicted seconds for ``count`` ops moving
+    ``payload_bytes`` over this (kind-class, axes) wire is
+    ``count·alpha_s + payload_bytes·sec_per_byte``."""
+
+    kind_class: str
+    axes: list | None
+    alpha_s: float
+    sec_per_byte: float
+    n_obs: int
+
+    @property
+    def gbps(self) -> float | None:
+        """The fitted bandwidth (1/β), for humans."""
+        if self.sec_per_byte <= 0:
+            return None
+        return 1.0 / self.sec_per_byte / 1e9
+
+    def predict(self, count: int, payload_bytes: int) -> float:
+        return count * self.alpha_s + payload_bytes * self.sec_per_byte
+
+
+@dataclass
+class ClassPrediction:
+    kind_class: str
+    axes: list | None
+    count: int
+    payload_bytes: int
+    predicted_s: float
+    covered: bool  # a fitted term existed (vs the pooled fallback)
+
+
+@dataclass
+class Prediction:
+    """Predicted cost of one program: compute + per-class collectives."""
+
+    program: str
+    step_s: float | None
+    compute_s: float | None
+    collective_s: float
+    wire_bytes: int
+    classes: list = field(default_factory=list)
+    coverage: float = 1.0  # fraction of classes with a fitted term
+    flops: float | None = None
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+def _fit_term(obs: list[tuple[int, int, float]]) -> tuple[float, float]:
+    """Nonnegative (α, sec/byte) for observations ``(count, bytes,
+    seconds)``.  One observation pins the bandwidth (α=0); several get
+    a least-squares fit, falling back to a through-origin bandwidth (or
+    pure latency when the class never carries payload) whenever the
+    unconstrained solution goes negative — a cost term must never
+    predict negative time."""
+    counts = np.array([o[0] for o in obs], float)
+    nbytes = np.array([o[1] for o in obs], float)
+    times = np.array([o[2] for o in obs], float)
+    if not nbytes.any():
+        denom = float((counts * counts).sum())
+        return (float((times * counts).sum() / denom) if denom else 0.0, 0.0)
+    if len(obs) == 1:
+        return 0.0, float(times[0] / nbytes[0])
+    A = np.stack([counts, nbytes], axis=1)
+    sol, *_ = np.linalg.lstsq(A, times, rcond=None)
+    alpha, spb = float(sol[0]), float(sol[1])
+    if alpha < 0 or spb < 0:
+        # pick the better single-term model by residual: a latency-
+        # dominated class (CPU-sim dispatch) must keep its α, a
+        # bandwidth-dominated one its β
+        a_only = float((times * counts).sum() / (counts * counts).sum())
+        b_only = float((times * nbytes).sum() / (nbytes * nbytes).sum())
+        sse_a = float(((times - a_only * counts) ** 2).sum())
+        sse_b = float(((times - b_only * nbytes) ** 2).sum())
+        alpha, spb = (a_only, 0.0) if sse_a <= sse_b else (0.0, b_only)
+    return alpha, spb
+
+
+@dataclass
+class CostModel:
+    """α–β terms per collective class + a seconds-per-FLOP compute
+    term, fitted from persisted attribution rows (`fit`)."""
+
+    terms: dict = field(default_factory=dict)  # _class_key -> ClassTerm
+    sec_per_flop: float | None = None
+    # fixed per-step compute overhead (dispatch/launch — the intercept
+    # of the compute fit; on CPU-sim it dominates small programs)
+    base_s: float = 0.0
+    fallback_sec_per_byte: float | None = None
+    n_rows: int = 0
+    platform: str | None = None
+    version: int = MODEL_VERSION
+
+    def term_for(self, kind: str, axes) -> ClassTerm | None:
+        return self.terms.get(_class_key(kind, axes))
+
+    def predict_classes(
+        self, class_rows: list[dict], *, flops: float | None = None,
+        program: str = "",
+    ) -> Prediction:
+        """Predicted cost of a program given its per-class collective
+        rows — either an attribution row's ``classes`` (payload_bytes)
+        or `CollectivePlan.rows()` (bytes).  Classes with no fitted
+        term ride the pooled fallback bandwidth and are reported as
+        uncovered (``coverage`` is the honesty number: a ranking built
+        on 40% coverage should say so)."""
+        preds = []
+        covered = 0
+        wire = 0
+        coll = 0.0
+        for c in class_rows:
+            count = int(c.get("count", 1))
+            payload = int(
+                c["payload_bytes"] if "payload_bytes" in c else c["bytes"]
+            )
+            minor = (
+                (c.get("max_elems") or MINOR_ELEMS + 1) <= MINOR_ELEMS
+            )
+            term = self.term_for(c["kind"], c.get("axes"))
+            if term is not None:
+                t = term.predict(count, 0 if minor else payload)
+                if not minor and payload and term.sec_per_byte == 0:
+                    # term fitted only from minor (latency) observations:
+                    # price this major payload at the pooled bandwidth
+                    t += payload * (self.fallback_sec_per_byte or 0.0)
+                covered += 1
+            else:
+                t = 0.0 if minor else (
+                    payload * (self.fallback_sec_per_byte or 0.0)
+                )
+            kls, axes = _class_key(c["kind"], c.get("axes"))
+            preds.append(ClassPrediction(
+                kind_class=kls,
+                axes=list(axes) if axes is not None else None,
+                count=count,
+                payload_bytes=payload,
+                predicted_s=t,
+                covered=term is not None,
+            ))
+            wire += payload
+            coll += t
+        compute = (
+            self.base_s + flops * self.sec_per_flop
+            if flops and self.sec_per_flop is not None else None
+        )
+        return Prediction(
+            program=program,
+            step_s=coll + (compute or 0.0),
+            compute_s=compute,
+            collective_s=coll,
+            wire_bytes=wire,
+            classes=preds,
+            coverage=(covered / len(preds)) if preds else 1.0,
+            flops=flops,
+        )
+
+    def predict_plan(self, plan, *, flops: float | None = None) -> Prediction:
+        """Predicted cost of one `analysis.plan.CollectivePlan` (pass
+        ``flops`` from XLA cost analysis for the compute term)."""
+        return self.predict_classes(
+            plan.rows(), flops=flops, program=plan.name
+        )
+
+    def summary(self) -> dict:
+        return {
+            "n_rows": self.n_rows,
+            "platform": self.platform,
+            "sec_per_flop": self.sec_per_flop,
+            "base_s": self.base_s,
+            "fallback_sec_per_byte": self.fallback_sec_per_byte,
+            "terms": [asdict(t) for _, t in sorted(
+                self.terms.items(), key=lambda kv: repr(kv[0])
+            )],
+            "version": self.version,
+        }
+
+    @classmethod
+    def from_summary(cls, d: dict) -> "CostModel":
+        terms = {}
+        for t in d.get("terms", []):
+            term = ClassTerm(**t)
+            terms[(term.kind_class,
+                   tuple(term.axes) if term.axes is not None else None)] = term
+        return cls(
+            terms=terms,
+            sec_per_flop=d.get("sec_per_flop"),
+            base_s=d.get("base_s", 0.0),
+            fallback_sec_per_byte=d.get("fallback_sec_per_byte"),
+            n_rows=d.get("n_rows", 0),
+            platform=d.get("platform"),
+            version=d.get("version", MODEL_VERSION),
+        )
+
+
+def fit(rows: list[dict], *, platform: str | None = None) -> CostModel:
+    """Fit a `CostModel` from attribution rows (the persisted
+    ``attribution.jsonl`` dicts — `observe.attribution
+    .load_attribution_rows`).  Every measured class of every row is one
+    (count, bytes, seconds) observation for its (kind-class, axes)
+    term; rows' ``compute_s``/``flops`` pairs fit the seconds-per-FLOP
+    term by least squares through the origin."""
+    obs: dict[tuple, list] = {}
+    flop_pairs = []
+    for row in rows:
+        for c in row.get("classes", []):
+            t = c.get("measured_s")
+            if t is None or t <= 0:
+                continue
+            key = _class_key(c["kind"], c.get("axes"))
+            # A MINOR class (scalar loss/predicate plumbing) is pure
+            # dispatch latency: its handful of payload bytes must never
+            # define the wire's bandwidth (a 12-byte scalar reduce would
+            # otherwise price a megabyte gradient reduce in SECONDS) —
+            # it contributes to α only.
+            minor = (c.get("max_elems") or MINOR_ELEMS + 1) <= MINOR_ELEMS
+            obs.setdefault(key, []).append(
+                (int(c.get("count", 1)),
+                 0 if minor else int(c.get("payload_bytes", 0)),
+                 float(t))
+            )
+        f, comp = row.get("flops"), row.get("compute_s")
+        if f and comp is not None and comp >= 0:
+            flop_pairs.append((float(f), float(comp)))
+    terms = {}
+    total_t = total_b = 0.0
+    for key, o in obs.items():
+        alpha, spb = _fit_term(o)
+        terms[key] = ClassTerm(
+            kind_class=key[0],
+            axes=list(key[1]) if key[1] is not None else None,
+            alpha_s=alpha,
+            sec_per_byte=spb,
+            n_obs=len(o),
+        )
+        total_t += sum(t for _, _, t in o)
+        total_b += sum(b for _, b, _ in o)
+    spf, base = None, 0.0
+    if flop_pairs:
+        fs = np.array([p[0] for p in flop_pairs])
+        cs = np.array([p[1] for p in flop_pairs])
+        if len(flop_pairs) >= 2:
+            # latency + rate, like the collective terms: compute =
+            # base + flops·spf (CPU-sim dispatch overhead dominates
+            # tiny programs — a through-origin fit can't carry both a
+            # small and a large program)
+            A = np.stack([np.ones_like(fs), fs], axis=1)
+            sol, *_ = np.linalg.lstsq(A, cs, rcond=None)
+            base, spf = float(sol[0]), float(sol[1])
+            if spf < 0:
+                spf, base = 0.0, float(cs.mean())
+            elif base < 0:
+                base, spf = 0.0, float((fs * cs).sum() / (fs * fs).sum())
+        else:
+            spf = float(cs[0] / fs[0])
+    return CostModel(
+        terms=terms,
+        sec_per_flop=spf,
+        base_s=base,
+        fallback_sec_per_byte=(total_t / total_b) if total_b else None,
+        n_rows=len(rows),
+        platform=platform,
+    )
+
+
+# ------------------------------------------------------------- calibration
+
+
+def select_calibration_rows(rows: list[dict]) -> dict[str, list[dict]]:
+    """Per-program calibration row sets: for each program, only the
+    rows whose ``spec_hash`` matches that program's LATEST row (the
+    provenance contract — a row measured for an older program shape
+    must not calibrate the current one).  Programs whose latest row
+    predates spec-hash stamping keep only their unhashed rows."""
+    latest = {}
+    for r in rows:
+        latest[r.get("program")] = r
+    out: dict[str, list[dict]] = {}
+    for prog, last in latest.items():
+        if prog is None:
+            continue
+        want = last.get("spec_hash")
+        out[prog] = [
+            r for r in rows
+            if r.get("program") == prog and r.get("spec_hash") == want
+        ]
+    return out
+
+
+def calibration_check(
+    rows: list[dict],
+    *,
+    tolerance: float = DEFAULT_TOLERANCE,
+    jax_version: str | None = None,
+) -> tuple[CostModel, list[dict]]:
+    """The ``make costcheck`` gate: fit on the persisted attribution
+    rows, predict each program's own latest measured step time back,
+    and report one verdict row per program —
+
+        {program, spec_hash, measured_s, predicted_s, error, status}
+
+    with status ``ok`` / ``violation`` (relative error past
+    ``tolerance``) / ``skew`` (row recorded under a different jax —
+    lowering and timing shift across versions, so the gate is waived,
+    analyzer-style; re-run ``make attribute`` to re-arm) / ``no-step``
+    (a plan-only row with no measured step time: nothing to check)."""
+    from tpu_dist.observe import results as results_mod
+
+    per_prog = select_calibration_rows(rows)
+    fit_rows = [r for rs in per_prog.values() for r in rs]
+    model = fit(fit_rows)
+    verdicts = []
+    for prog in sorted(per_prog):
+        prog_rows = per_prog[prog]
+        if not prog_rows:
+            continue
+        last = prog_rows[-1]
+        verdict = {
+            "program": prog,
+            "spec_hash": last.get("spec_hash"),
+            "measured_s": last.get("step_time_s"),
+            "predicted_s": None,
+            "error": None,
+            "status": "ok",
+        }
+        recorded = results_mod.row_jax_version(last)
+        if (jax_version is not None and recorded is not None
+                and recorded != jax_version):
+            verdict["status"] = "skew"
+            verdict["recorded_jax"] = recorded
+            verdicts.append(verdict)
+            continue
+        measured = last.get("step_time_s")
+        if not measured:
+            verdict["status"] = "no-step"
+            verdicts.append(verdict)
+            continue
+        pred = model.predict_classes(
+            last.get("classes", []), flops=last.get("flops"), program=prog
+        )
+        verdict["predicted_s"] = pred.step_s
+        err = abs(pred.step_s - measured) / measured
+        verdict["error"] = round(err, 4)
+        verdict["status"] = "ok" if err <= tolerance else "violation"
+        verdicts.append(verdict)
+    return model, verdicts
+
+
+def blessed_tolerance_path(goldens_dir: str) -> str:
+    return os.path.join(goldens_dir, "costcheck.json")
+
+
+def load_blessed_tolerance(goldens_dir: str) -> float | None:
+    """The blessed ``make costcheck`` tolerance from
+    ``tests/goldens/costcheck.json`` (None = not blessed; the CLI
+    falls back to `DEFAULT_TOLERANCE`)."""
+    try:
+        with open(blessed_tolerance_path(goldens_dir),
+                  encoding="utf-8") as fh:
+            return float(json.load(fh)["tolerance"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def save_blessed_tolerance(goldens_dir: str, tolerance: float) -> str:
+    os.makedirs(goldens_dir, exist_ok=True)
+    path = blessed_tolerance_path(goldens_dir)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(
+            {"tolerance": float(tolerance),
+             "note": "make costcheck: max relative predicted-vs-measured "
+                     "step-time error (bless with "
+                     "python -m tpu_dist.analysis.advise --costcheck "
+                     "--bless-tolerance T)"},
+            fh, indent=2, sort_keys=True,
+        )
+        fh.write("\n")
+    return path
+
+
+# ------------------------------------------------------ bubble prediction
+
+
+def predict_bubble_fraction(schedule, fwd_s, bwd_s) -> float:
+    """Predicted bubble fraction of one `parallel.pipeline.Schedule`
+    table under per-stage costs.
+
+    ``fwd_s`` / ``bwd_s``: scalar (uniform) or per-GLOBAL-STAGE cost
+    sequences of length ``n·v`` (global stage ``g = chunk·n + rank`` —
+    the `stage_cost_programs` / ``stage_costs.jsonl`` convention; for
+    v=1 that is just per-rank).  The executor runs the table in
+    lockstep — both neighbor rings fire every tick — so a tick lasts as
+    long as its slowest active op, and the bubble is the fraction of
+    rank-time not spent doing work:
+
+        bubble = 1 − Σ own-op costs / (n · Σ_t max_s cost[t, s])
+
+    Uniform costs reduce this exactly to `Schedule.bubble_fraction()`
+    (tested); measured unbalanced costs are what ROADMAP item 4's
+    schedule generator will minimize."""
+    n, v, T = schedule.n, schedule.n_chunks, schedule.ticks
+    n_global = n * v
+
+    def per_stage(x):
+        arr = np.asarray(x, float).reshape(-1)
+        if arr.size == 1:
+            return np.full(n_global, float(arr[0]))
+        if arr.size != n_global:
+            raise ValueError(
+                f"need a scalar or {n_global} per-global-stage costs "
+                f"(n={n} ranks x v={v} chunks), got {arr.size}"
+            )
+        return arr
+
+    fwd = per_stage(fwd_s)
+    bwd = per_stage(bwd_s)
+    if (fwd < 0).any() or (bwd < 0).any():
+        raise ValueError("stage costs must be nonnegative")
+    # IDLE/FWD/BWD = 0/1/2 (parallel.pipeline) — static numpy tables,
+    # no jax needed here
+    g = schedule.chunk * n + np.arange(n)[None, :]
+    d = np.where(
+        schedule.ops == 1, fwd[g], np.where(schedule.ops == 2, bwd[g], 0.0)
+    )
+    tick_dur = d.max(axis=1)
+    total = float(tick_dur.sum()) * n
+    if total <= 0:
+        return 0.0
+    return float(1.0 - d.sum() / total)
+
+
+def stage_table_from_rows(rows: list[dict]) -> dict | None:
+    """The newest COMPLETE per-stage cost table from persisted
+    ``stage_costs.jsonl`` rows: the latest recording group (same
+    ``spec_hash``, falling back to the model name for unhashed legacy
+    rows) with every stage 0..n−1 present.  Returns ``{model,
+    spec_hash, n_stages, fwd_s, bwd_s}`` with per-global-stage cost
+    lists, or None when no complete table exists."""
+    if not rows:
+        return None
+    # group key per measurement run; file order is recording order
+    def gkey(r):
+        return r.get("spec_hash") or f"model:{r.get('model')}"
+
+    ordered_keys = []
+    for r in rows:
+        k = gkey(r)
+        if k not in ordered_keys:
+            ordered_keys.append(k)
+    for key in reversed(ordered_keys):
+        group = [r for r in rows if gkey(r) == key]
+        n = int(group[-1].get("n_stages", 0))
+        if n <= 0:
+            continue
+        latest_per_stage: dict[int, dict] = {}
+        for r in group:
+            if int(r.get("n_stages", -1)) == n:
+                latest_per_stage[int(r["stage"])] = r
+        if set(latest_per_stage) != set(range(n)):
+            continue
+        return {
+            "model": group[-1].get("model"),
+            "spec_hash": group[-1].get("spec_hash"),
+            "n_stages": n,
+            "fwd_s": [float(latest_per_stage[s]["fwd_s"]) for s in range(n)],
+            "bwd_s": [float(latest_per_stage[s]["bwd_s"]) for s in range(n)],
+        }
+    return None

@@ -26,6 +26,12 @@ depends on but that nothing used to CHECK mechanically:
                            sets, a large shardable leaf living fully
                            replicated defeats the memory story the rule
                            set exists for.
+- ``unplanned-reshard``  — a major collective whose (kind, axes)
+                           signature is not derivable from the rule
+                           set's data/model axis roles: a fall-through
+                           or user rule forcing a replication
+                           round-trip (all-gather + re-slice) inside
+                           the step.
 - ``reused-prng-key``    — the same PRNG key consumed by two samplers in
                            one traced fn produces correlated "random"
                            numbers; keys must be `split`/`fold_in`-
@@ -45,7 +51,7 @@ from typing import Any
 
 import numpy as np
 
-from tpu_dist.analysis.plan import MINOR_ELEMS, itemsize
+from tpu_dist.analysis.plan import KIND_CLASS, MINOR_ELEMS, itemsize
 
 # Leaves below this many elements never trigger the residency /
 # fallthrough lints — biases and norm scales are replicated by design.
@@ -478,6 +484,99 @@ def lint_replicated_residency(prog) -> list[Finding]:
     return findings
 
 
+def _ruleset_roles(ruleset) -> dict[str, str]:
+    """role -> BOUND mesh axis name for one `parallel.RuleSet`.  The
+    rule-set ``name`` is role-based ('dp+fsdp', 'zero1', ...) in the
+    same order the spec named its axes, which is also the order
+    ``data_axes`` was built in — so zipping recovers the binding even
+    when the trainers bound roles onto a differently-named mesh axis."""
+    name = ruleset.name or ""
+    if name == "zero1":
+        data_roles = ["dp"]
+    else:
+        data_roles = [r for r in name.split("+") if r in ("dp", "fsdp")]
+    roles = dict(zip(data_roles, ruleset.data_axes))
+    if ruleset.model_axes:
+        roles["tp"] = ruleset.model_axes[0]
+    return roles
+
+
+def lint_unplanned_reshard(prog) -> list[Finding]:
+    """Every MAJOR collective of an engine program must be derivable
+    from the rule set's data/model axis roles:
+
+    - ``reduce`` class over any subset of the data+model axes — the
+      gradient sync / tp partial sums the rule set plans;
+    - ``gather`` class over the axes that legitimately shard persistent
+      state or tp activations: the fsdp-role axis (param entry/exit
+      gathers), the dp axis when the update is sharded (every rule set
+      but plain dp — the ZeRO output gather), the model axes, and the
+      data axes under compression (the quantized all-gather leg);
+    - ``all-to-all`` over any planned axes: GSPMD rotating which axis a
+      tensor shards over (same total bytes — strictly cheaper than the
+      gather+re-slice it replaces) or the compressed wire's chunk
+      exchange;
+    - ``collective-permute`` never (the engine plans no rings).
+
+    Anything else is a GSPMD-inserted reshard the configuration never
+    asked for — the signature of a fall-through or user rule forcing a
+    replication round-trip (all-gather + re-slice) inside the step,
+    silently costing wire bytes every iteration."""
+    built = getattr(prog, "built", None)
+    if built is None:
+        return []  # no rule-set context: nothing to derive from
+    rs = built.ruleset
+    roles = _ruleset_roles(rs)
+    data = set(rs.data_axes)
+    known = data | set(rs.model_axes)
+    compressed = getattr(prog, "compress", None) is not None
+    gather_ok = set(rs.model_axes)
+    if "fsdp" in roles:
+        gather_ok.add(roles["fsdp"])
+    if rs.name != "dp" and "dp" in roles:
+        gather_ok.add(roles["dp"])
+    if compressed:
+        gather_ok |= data
+    findings = []
+    for c in prog.plan:
+        if c.minor or c.axes is None:
+            continue  # scalar plumbing / unrecognized sub-ring groups
+        axes = set(c.axes)
+        kls = KIND_CLASS.get(c.kind, c.kind)
+        if kls == "reduce":
+            ok = axes <= known
+        elif kls == "gather":
+            ok = axes <= gather_ok
+        elif kls == "all-to-all":
+            # an a2a over planned axes is GSPMD ROTATING which axis a
+            # tensor shards over (or the compressed wire's chunk
+            # exchange) — same total bytes, strictly cheaper than the
+            # gather+re-slice it replaces; only foreign axes flag
+            ok = axes <= known
+        else:  # permute — the engine plans no rings
+            ok = False
+        if not ok:
+            findings.append(
+                Finding(
+                    lint="unplanned-reshard",
+                    program=prog.name,
+                    message=(
+                        f"{c.kind} over {tuple(sorted(axes))} "
+                        f"({c.dtype_key}, {c.bytes} B) is not derivable "
+                        f"from rule set {rs.name!r} (data axes "
+                        f"{tuple(rs.data_axes)}, model axes "
+                        f"{tuple(rs.model_axes)}"
+                        + (", compressed" if compressed else "")
+                        + ") — a fall-through or user rule is forcing a "
+                        "replication round-trip inside the step"
+                    ),
+                    detail={"kind": c.kind, "axes": sorted(axes),
+                            "dtype": c.dtype_key, "bytes": c.bytes},
+                )
+            )
+    return findings
+
+
 def lint_reused_keys(prog) -> list[Finding]:
     """The same PRNG key consumed by ≥2 samplers in one traced scope."""
     return [
@@ -502,6 +601,7 @@ ALL_LINTS = {
     "dead-rule": lint_dead_rules,
     "replicated-fallthrough": lint_replicated_fallthrough,
     "replicated-residency": lint_replicated_residency,
+    "unplanned-reshard": lint_unplanned_reshard,
     "reused-prng-key": lint_reused_keys,
 }
 

@@ -21,6 +21,11 @@ is the measurement substrate the ROADMAP's perf PRs cite:
 - `memory`    — live memory snapshots (HBM, host-RSS fallback on
                 CPU-sim), phase-bucketed watermark accounting, and OOM
                 forensics (`record_oom` → flight dump + ``oom`` event)
+- `results`   — the shared loader for the persisted
+                ``benchmarks/results/*.jsonl`` records (metric-series /
+                platform-provenance filtering) that `regress`, the
+                attribution row gates, and `analysis.costmodel` all
+                route through
 - `regress`   — trailing-median regression checker over the persisted
                 bench trajectory (``python -m tpu_dist.observe.regress``;
                 a ``-m`` CLI like flightrec's merge — import it
@@ -39,10 +44,11 @@ from tpu_dist.observe import (
     heartbeat,
     memory,
     registry,
+    results,
     spans,
 )
 
 __all__ = [
     "events", "flightrec", "heartbeat", "memory", "registry",
-    "spans",
+    "results", "spans",
 ]

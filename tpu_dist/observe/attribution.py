@@ -38,9 +38,12 @@ therefore not imported by ``tpu_dist.observe.__init__``.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import time
 from dataclasses import asdict, dataclass, field
+
+from tpu_dist.observe import results as results_mod
 
 REPORT_VERSION = 1
 
@@ -53,6 +56,16 @@ _REPLAY_DTYPES = {
     "s64": "int64", "u64": "uint64",
 }
 _ITEMSIZE_FALLBACK = {1: "int8", 2: "int16", 4: "int32", 8: "int64"}
+
+
+def program_fingerprint(payload) -> str:
+    """Short stable hash of a program/model spec (canonical-JSON
+    sha256, 12 hex chars).  Stamped onto persisted attribution and
+    stage-cost rows so calibration consumers (`analysis.costmodel`)
+    only fit rows recorded for the SAME program shape — a row measured
+    before a model was widened must not calibrate the widened one."""
+    text = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:12]
 
 
 @dataclass
@@ -88,6 +101,12 @@ class AttributionReport:
     compute_s: float | None = None
     iters: int = 0
     golden: str | None = None   # golden-gate status when checked
+    # program provenance: spec hash over the plan rows + mesh shape
+    # (`program_fingerprint`), so calibration only consumes rows
+    # recorded for THIS program shape; flops = XLA cost analysis of the
+    # compiled step (the cost model's compute-term input)
+    spec_hash: str | None = None
+    flops: float | None = None
     version: int = REPORT_VERSION
 
     def rows(self) -> list[dict]:
@@ -125,6 +144,8 @@ class AttributionReport:
             compute_s=d.get("compute_s"),
             iters=d.get("iters", 0),
             golden=d.get("golden"),
+            spec_hash=d.get("spec_hash"),
+            flops=d.get("flops"),
             version=d.get("version", REPORT_VERSION),
         )
 
@@ -390,6 +411,13 @@ def attribute_program(
         _time_step(program, iters=iters, warmup=warmup)
         if measure_step else None
     )
+    flops = None
+    try:
+        from tpu_dist.train import flops as flops_mod
+
+        flops = flops_mod.xla_flops(program.fn, *program.args)
+    except Exception:
+        pass
     coll_s = (
         sum(c.measured_s for c in classes if c.measured_s is not None)
         if classes else 0.0
@@ -408,7 +436,20 @@ def attribute_program(
         collective_s=coll_s if classes else None,
         compute_s=compute_s,
         iters=iters,
+        spec_hash=plan_spec_hash(plan),
+        flops=flops,
     )
+
+
+def plan_spec_hash(plan) -> str:
+    """The provenance fingerprint of one `CollectivePlan`: program name
+    + mesh shape + aggregated collective rows — changes whenever the
+    program's wire structure (and therefore its cost profile) does."""
+    return program_fingerprint({
+        "program": plan.name,
+        "mesh_axes": dict(plan.mesh_axes),
+        "rows": plan.rows(),
+    })
 
 
 def check_against_golden(report: AttributionReport,
@@ -501,6 +542,22 @@ def measure_stage_costs(
             "in_shape": list(getattr(x, "shape", ())),
             "out_shape": list(getattr(y, "shape", ())),
         })
+    # Program provenance (same discipline as `AttributionReport`): the
+    # spec hash covers the whole pipeline's stage structure, so every
+    # stage row of one measurement run carries the SAME hash and a
+    # calibration consumer can select a complete, self-consistent table.
+    spec_hash = program_fingerprint({
+        "model": model,
+        "stages": [
+            {k: r[k] for k in
+             ("stage", "n_stages", "params_bytes", "in_shape", "out_shape")}
+            for r in rows
+        ],
+    })
+    mesh_shape = {"pipe": len(progs)}
+    for r in rows:
+        r["spec_hash"] = spec_hash
+        r["mesh_shape"] = mesh_shape
     return rows
 
 
@@ -517,6 +574,55 @@ def persist_stage_costs(rows: list[dict], *, root: str | None = None) -> str:
             root=root, out_name="stage_costs.jsonl",
         )
     return path
+
+
+# ------------------------------------------------------- persisted rows
+
+
+def load_attribution_rows(
+    path: str | None = None,
+    *,
+    program: str | None = None,
+    platform: str | None = None,
+    spec_hash: str | None = None,
+) -> list[dict]:
+    """The persisted ``attribution.jsonl`` rows (`make attribute`), in
+    recording order, via the shared `observe.results` loader.  Filters:
+    ``program`` name, ``platform`` provenance, and ``spec_hash`` (only
+    rows measured for that exact program shape)."""
+    path = path or results_mod.results_path("attribution.jsonl")
+    rows = results_mod.load_rows(
+        path, series="attribution", platform=platform,
+        require=("program", "classes"),
+    )
+    if program is not None:
+        rows = [r for r in rows if r.get("program") == program]
+    if spec_hash is not None:
+        rows = [r for r in rows if r.get("spec_hash") == spec_hash]
+    return rows
+
+
+def load_stage_cost_rows(
+    path: str | None = None,
+    *,
+    model: str | None = None,
+    platform: str | None = None,
+    spec_hash: str | None = None,
+) -> list[dict]:
+    """The persisted ``stage_costs.jsonl`` rows (`make attribute`), in
+    recording order, via the shared `observe.results` loader — the
+    measured F/B cost tables `analysis.costmodel.predict_bubble_fraction`
+    and ROADMAP item 4's schedule generator consume."""
+    path = path or results_mod.results_path("stage_costs.jsonl")
+    rows = results_mod.load_rows(
+        path, series="stage_cost", platform=platform,
+        require=("model", "stage", "n_stages", "fwd_s", "bwd_s"),
+    )
+    if model is not None:
+        rows = [r for r in rows if r.get("model") == model]
+    if spec_hash is not None:
+        rows = [r for r in rows if r.get("spec_hash") == spec_hash]
+    return rows
 
 
 # ------------------------------------------------------------- publication
@@ -567,6 +673,8 @@ def emit_report(report: AttributionReport, *, events_logger=None,
         classes=[asdict(c) for c in report.classes],
         mesh_axes=report.mesh_axes,
         golden=report.golden,
+        spec_hash=report.spec_hash,
+        flops=report.flops,
     )
 
 

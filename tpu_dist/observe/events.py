@@ -124,6 +124,16 @@ SCHEMA: dict[str, tuple[str, ...]] = {
     # static memory-plan gate (python -m tpu_dist.analysis.memory /
     # make memcheck): programs checked + golden gate status
     "memcheck": ("programs", "golden"),
+    # auto-sharding advisor (python -m tpu_dist.analysis.advise / make
+    # advise): ranked candidate configurations — "best" is the
+    # top-ranked {spec, compress, predicted_step_s, ...} summary (null
+    # when nothing survived pruning), "ranking" the full ordered list
+    "advice": ("model", "chips", "best", "ranking"),
+    # cost-model calibration gate (make costcheck): predicted-vs-
+    # measured step time per program with attribution rows; status
+    # "ok" | "violation" | "skew" (rows from a different jax, gate
+    # waived) | "no-rows"
+    "costcheck": ("programs", "tolerance", "status"),
 }
 
 

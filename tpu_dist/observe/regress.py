@@ -35,6 +35,8 @@ import os
 import statistics
 import sys
 
+from tpu_dist.observe import results as results_mod
+
 DEFAULT_THRESHOLD = 0.5
 DEFAULT_WINDOW = 8
 DEFAULT_MIN_HISTORY = 3
@@ -71,23 +73,9 @@ def default_path() -> str:
 
 def load_rows(path: str) -> list[dict]:
     """Every parseable JSON row of one JSONL file, file order (=
-    chronological: the file is append-only)."""
-    rows = []
-    try:
-        with open(path, encoding="utf-8") as fh:
-            for line in fh:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if isinstance(rec, dict):
-                    rows.append(rec)
-    except OSError:
-        return []
-    return rows
+    chronological: the file is append-only) — the shared
+    `observe.results.load_rows` parser."""
+    return results_mod.load_rows(path)
 
 
 def _series_key(rec: dict, field: str) -> tuple | None:
@@ -96,9 +84,7 @@ def _series_key(rec: dict, field: str) -> tuple | None:
         return None
     # provenance split: CPU-fallback rounds must not be judged against
     # a TPU median (or vice versa)
-    platform = rec.get("platform")
-    if platform is None:
-        platform = (rec.get("provenance") or {}).get("backend")
+    platform = results_mod.row_platform(rec)
     if platform is None and rec.get("memory_source") == "hbm":
         platform = "tpu"  # an HBM reading implies a tracked accelerator
     # sub-series discriminators some benches carry (one metric, many

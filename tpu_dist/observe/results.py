@@ -1,0 +1,121 @@
+"""Shared loader for the persisted ``benchmarks/results/*.jsonl`` records.
+
+Three consumers grew their own hand-rolled JSONL parsing of the bench
+trajectory — `observe.regress` (trailing-median regression checks over
+``bench_runs.jsonl``), the attribution row-parse gate
+(``benchmarks/attribute.py`` over ``stage_costs.jsonl``), and now the
+static cost model (`analysis.costmodel` over ``attribution.jsonl``).
+This module is the one parser they all route through:
+
+- `load_rows(path, series=..., platform=..., require=...)` — every
+  parseable JSON object row of one append-only JSONL file, in file
+  (= chronological) order, optionally filtered by metric series,
+  platform provenance, and required keys;
+- `row_platform(rec)` — the backend a row was measured on, read from
+  its ``platform`` field or `bench.persist_event` provenance (the
+  split that keeps a CPU-fallback round from being judged against a
+  TPU median);
+- `latest_by(rows, key)` — the newest row per key (file order wins),
+  for "latest reading per program/series" consumers.
+
+Stdlib-only, like the rest of `tpu_dist.observe`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Iterable
+
+
+def results_dir() -> str:
+    """The repo's ``benchmarks/results/`` directory."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "benchmarks", "results")
+
+
+def results_path(name: str) -> str:
+    """``benchmarks/results/<name>`` (e.g. ``attribution.jsonl``)."""
+    return os.path.join(results_dir(), name)
+
+
+def row_platform(rec: dict) -> str | None:
+    """The backend one persisted row was measured on: the explicit
+    ``platform`` field when present, else `bench.persist_event`'s
+    ``provenance.backend``, else None (unattributable)."""
+    platform = rec.get("platform")
+    if platform is None:
+        prov = rec.get("provenance")
+        if isinstance(prov, dict):
+            platform = prov.get("backend")
+    return str(platform) if platform is not None else None
+
+
+def row_jax_version(rec: dict) -> str | None:
+    """The jax version a row was recorded under (provenance), or None."""
+    prov = rec.get("provenance")
+    if isinstance(prov, dict) and prov.get("jax_version") is not None:
+        return str(prov["jax_version"])
+    return None
+
+
+def load_rows(
+    path: str,
+    *,
+    series: str | Iterable[str] | None = None,
+    platform: str | None = None,
+    require: Iterable[str] = (),
+) -> list[dict]:
+    """Every parseable JSON object row of one JSONL file, in file order
+    (= chronological: the results files are append-only).  Unparseable
+    and non-object lines are skipped, a missing file is an empty list —
+    the consumers are all "judge whatever trajectory exists" tools.
+
+    ``series`` keeps only rows whose ``metric`` field matches (a string
+    or an iterable of strings); ``platform`` keeps only rows whose
+    `row_platform` provenance matches (rows with NO provenance are kept
+    — old records must not vanish from a filtered view just because
+    they predate provenance stamping); ``require`` lists keys every
+    returned row must carry."""
+    if series is not None and isinstance(series, str):
+        series = (series,)
+    wanted = set(series) if series is not None else None
+    required = tuple(require)
+    rows = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                if wanted is not None and rec.get("metric") not in wanted:
+                    continue
+                if platform is not None:
+                    p = row_platform(rec)
+                    if p is not None and p != platform:
+                        continue
+                if any(k not in rec for k in required):
+                    continue
+                rows.append(rec)
+    except OSError:
+        return []
+    return rows
+
+
+def latest_by(rows: Iterable[dict], key: Callable[[dict], object]) -> dict:
+    """The newest row per ``key(row)`` (later file position wins — the
+    files are append-only, so file order is recording order).  Rows
+    whose key is None are dropped."""
+    out: dict = {}
+    for rec in rows:
+        k = key(rec)
+        if k is not None:
+            out[k] = rec
+    return out

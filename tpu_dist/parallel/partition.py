@@ -502,6 +502,43 @@ def build_mesh(
     )
 
 
+def enumerate_mesh_axes(
+    n_chips: int,
+    *,
+    tp: bool = False,
+    zero1: bool = True,
+) -> list[str]:
+    """Every built-in mesh_axes spec expressible at ``n_chips`` chips —
+    the candidate space `analysis.advisor` ranks statically.
+
+    Covers the single-axis rule sets (``dp=N``, ``zero1:dp=N``,
+    ``fsdp=N``) plus every 2-axis factorization of the chip count:
+    ``dp=a,fsdp=b`` always, ``dp=a,tp=b`` when ``tp=True`` (the
+    Megatron vocabulary only binds to transformer parameter names —
+    pointless for models it cannot shard).  Each spec resolves through
+    `resolve_rules` on a `build_mesh` of that shape, so the enumeration
+    and the engine can never disagree about what a candidate means.
+    Deterministic order (the advisor's tie-break)."""
+    n = int(n_chips)
+    if n < 1:
+        raise ValueError(f"need at least one chip, got {n}")
+    specs = [f"dp={n}"]
+    if n >= 2:
+        if zero1:
+            specs.append(f"zero1:dp={n}")
+        specs.append(f"fsdp={n}")
+    for a in range(2, n):
+        if n % a:
+            continue
+        b = n // a
+        if b < 2:
+            continue
+        specs.append(f"dp={a},fsdp={b}")
+        if tp:
+            specs.append(f"dp={a},tp={b}")
+    return specs
+
+
 def resolve_rules(
     spec: str,
     mesh: Mesh,
