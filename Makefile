@@ -12,7 +12,8 @@ DEMOFLAGS = --world $(WORLD) --platform $(PLATFORM)
         chaos-resume docs demos telemetry-demo bench-dispatch bench-compress \
         bench-pipeline bench-decode bench-serve serve-demo bench-mesh \
         analyze analyze-bless attribute attribute-smoke memcheck \
-        memcheck-bless regress advise advise-smoke costcheck
+        memcheck-bless regress advise advise-smoke costcheck \
+        chaos-reshard bench-reshard
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -52,6 +53,12 @@ telemetry-demo:  # short traced training run; asserts the events file parses
 
 chaos:  # the fault-injection suite (kill/retry/resume; spawns real gangs)
 	$(PY) -m pytest tests/ -q -m chaos
+
+chaos-reshard:  # elastic resume: kill mid-epoch -> resume on a different mesh + rule set -> bit-compare
+	$(PY) -m pytest tests/test_reshard.py -q -m "slow and chaos"
+
+bench-reshard:  # redistribution throughput + peak transient bytes vs the 2x-bucket bound (regress-gated)
+	$(PY) benchmarks/reshard.py --platform $(PLATFORM)
 
 chaos-resume:
 	cd demos && $(PY) chaos_resume.py $(DEMOFLAGS)

@@ -1,0 +1,613 @@
+"""Elastic resume (train.reshard): memory-bounded redistribution of
+saved checkpoints across topologies and rule sets.
+
+Fast half (tier-1): the redistribution engine itself — N→M resizes and
+rule-set swaps on a toy transformer-named tree (bitwise equality),
+npz sources, shape-mismatch resets, integrity verification, transient
+memory accounting against the 2×-largest-bucket bound, the ``reshard``
+telemetry event, `latest_intact` on partial sharded dirs, and the
+``kill_during_checkpoint`` chaos clause.
+
+Slow half (the `make chaos-reshard` lane): a training run killed
+mid-epoch resumes on a DIFFERENT mesh shape and rule set with a forward
+pass bit-identical to the unkilled run, and the launch supervisor
+re-probes the world size on an elastic relaunch.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpu_dist import train
+from tpu_dist.models.transformer_lm import TransformerLM
+from tpu_dist.observe import events, flightrec
+from tpu_dist.observe import memory as mem_mod
+from tpu_dist.parallel import partition as part
+from tpu_dist.resilience import chaos
+from tpu_dist.train import checkpoint, reshard
+
+N = 8
+
+
+def small_lm():
+    return TransformerLM(vocab=64, dim=32, heads=4, depth=2, max_seq=32)
+
+
+def toy_tree(seed=0):
+    """Transformer-named leaves (so the Megatron-style rule patterns
+    bind) plus a host scalar."""
+    rng = np.random.default_rng(seed)
+    return {
+        "attn": {"qkv": {"w": rng.normal(size=(16, 48)).astype(np.float32)}},
+        "mlp": {"fc1": {"w": rng.normal(size=(16, 64)).astype(np.float32)}},
+        "embed": {"table": rng.normal(size=(32, 16)).astype(np.float32)},
+        "step": np.int32(7),
+    }
+
+
+RULES = {
+    "dp": [(".*", P())],
+    "fsdp_row": [
+        ("attn/qkv/w", P("fsdp", None)),
+        ("mlp/fc1/w", P("fsdp", None)),
+        ("embed/table", P("fsdp", None)),
+        (".*", P()),
+    ],
+    "fsdp_col": [
+        ("attn/qkv/w", P(None, "fsdp")),
+        ("mlp/fc1/w", P(None, "fsdp")),
+        ("embed/table", P(None, "fsdp")),
+        (".*", P()),
+    ],
+    "tp": [
+        ("attn/qkv/w", P(None, "tp")),
+        ("mlp/fc1/w", P(None, "tp")),
+        ("embed/table", P("tp", None)),
+        (".*", P()),
+    ],
+}
+
+
+def mesh_of(spec, ndev=None):
+    devs = jax.devices("cpu")
+    return part.build_mesh(
+        spec, mesh_devices=devs[: ndev if ndev else len(devs)]
+    )
+
+
+def place(tree, rules, mesh):
+    specs = part.match_partition_rules(rules, tree, mesh)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def assert_trees_equal(a, b):
+    fa, _ = checkpoint._flatten_with_paths(a)
+    fb, _ = checkpoint._flatten_with_paths(b)
+    for (kp, x), (_, y) in zip(fa, fb, strict=True):
+        np.testing.assert_array_equal(
+            np.asarray(x), np.asarray(y), err_msg=kp
+        )
+
+
+# ------------------------------------------------------- the engine itself
+
+
+class TestRedistribute:
+    CASES = [
+        # (source spec, source rules, target spec, target devs, tgt rules)
+        ("dp=8", "dp", "dp=4", 4, "dp"),                 # dp down-resize
+        ("dp=4", "dp", "dp=8", 8, "dp"),                 # dp up-resize
+        ("fsdp=8", "fsdp_row", "fsdp=4", 4, "fsdp_row"),  # fsdp down
+        ("fsdp=4", "fsdp_row", "fsdp=8", 8, "fsdp_row"),  # fsdp up
+        ("dp=2,fsdp=4", "fsdp_row", "dp=2,fsdp=2", 4, "fsdp_row"),
+        ("dp=2,tp=4", "tp", "dp=2,tp=2", 4, "tp"),       # tp resize
+        ("dp=8", "dp", "dp=2,fsdp=4", 8, "fsdp_row"),    # dp -> fsdp
+        ("dp=2,tp=4", "tp", "dp=2,fsdp=2", 4, "fsdp_col"),  # dp.tp -> dp.fsdp
+        ("fsdp=8", "fsdp_row", "fsdp=8", 8, "fsdp_col"),  # re-shard axis swap
+    ]
+
+    @pytest.mark.parametrize(
+        "src_spec,src_rules,tgt_spec,tgt_ndev,tgt_rules", CASES
+    )
+    def test_resize_and_rule_swap_bitwise(
+        self, tmp_path, src_spec, src_rules, tgt_spec, tgt_ndev, tgt_rules
+    ):
+        tree = toy_tree()
+        src = place(tree, RULES[src_rules], mesh_of(src_spec))
+        ck = tmp_path / "ckpt_0"
+        checkpoint.save_sharded(
+            ck, src, step=7,
+            partition={"rules": src_rules, "axes": {"dp": 1}},
+        )
+        tmpl = reshard.target_templates(
+            tree, RULES[tgt_rules], mesh_of(tgt_spec, tgt_ndev)
+        )
+        out, step = reshard.redistribute(ck, tmpl, bucket_bytes=1 << 10)
+        assert step == 7
+        assert_trees_equal(tree, out)
+        # every device leaf landed under the TARGET sharding
+        for (kp, t), (_, o) in zip(
+            checkpoint._flatten_with_paths(tmpl)[0],
+            checkpoint._flatten_with_paths(out)[0],
+            strict=True,
+        ):
+            assert o.sharding.is_equivalent_to(t.sharding, o.ndim), kp
+
+    def test_npz_source_redistributes(self, tmp_path):
+        tree = toy_tree()
+        f = tmp_path / "ckpt_1.npz"
+        checkpoint.save(
+            f, tree, step=9, partition={"rules": "dp", "axes": {"dp": 8}}
+        )
+        tmpl = reshard.target_templates(
+            tree, RULES["fsdp_col"], mesh_of("dp=2,fsdp=2", 4)
+        )
+        out, step = reshard.redistribute(f, tmpl, bucket_bytes=1 << 10)
+        assert step == 9
+        assert_trees_equal(tree, out)
+
+    def test_shape_mismatch_resets_to_zeros(self, tmp_path):
+        """Per-rank state whose physical shape is a function of the rule
+        set (the EF residual) cannot be redistributed — it is zero-reset
+        under the target sharding and reported in the plan."""
+        tree = toy_tree()
+        tree["residual"] = np.random.default_rng(1).normal(
+            size=(4, 8)
+        ).astype(np.float32)
+        src = place(tree, RULES["dp"], mesh_of("dp=8"))
+        ck = tmp_path / "ck"
+        checkpoint.save_sharded(
+            ck, src, step=2, partition={"rules": "dp", "axes": {"dp": 8}}
+        )
+        tgt_tree = dict(tree)
+        tgt_tree["residual"] = np.zeros((2, 16), np.float32)  # new layout
+        tmpl = reshard.target_templates(
+            tgt_tree, RULES["fsdp_row"], mesh_of("fsdp=4", 4)
+        )
+        plan = reshard.plan_reshard(ck, tmpl)
+        assert plan.reset_leaves  # the residual is in the reset set
+        out, _ = reshard.redistribute(ck, tmpl)
+        assert out["residual"].shape == (2, 16)
+        assert np.all(np.asarray(out["residual"]) == 0)
+        assert_trees_equal(
+            {k: v for k, v in tree.items() if k != "residual"},
+            {k: v for k, v in out.items() if k != "residual"},
+        )
+        with pytest.raises(reshard.ReshardError, match="on_shape_mismatch"):
+            reshard.redistribute(ck, tmpl, on_shape_mismatch="error")
+
+    def test_corrupt_blob_dies_in_verify_with_flight_trail(self, tmp_path):
+        tree = toy_tree()
+        src = place(tree, RULES["tp"], mesh_of("dp=2,tp=4"))
+        ck = tmp_path / "ck"
+        checkpoint.save_sharded(
+            ck, src, step=1, partition={"rules": "tp", "axes": {"dp": 2}}
+        )
+        blob = sorted((ck / "leaf_0").glob("*.npz"))[0]
+        z = dict(np.load(blob))
+        z["data"] = z["data"].copy()
+        z["data"][0] ^= 0xFF  # bit flip under a now-stale digest
+        with open(blob, "wb") as fh:
+            np.savez(fh, **z)
+        tmpl = reshard.target_templates(
+            tree, RULES["fsdp_row"], mesh_of("fsdp=4", 4)
+        )
+        flightrec._reset_for_tests()
+        with pytest.raises(reshard.ReshardError, match="verify") as ei:
+            reshard.redistribute(ck, tmpl)
+        assert ei.value.phase == "verify"
+        # the flight ring names the dying phase
+        marks = [
+            r for r in flightrec.get().snapshot()
+            if r.get("kind") == "mark" and r.get("what") == "reshard"
+        ]
+        assert marks and marks[-1]["phase"] == "failed"
+        assert marks[-1]["failed_phase"] == "verify"
+
+    def test_plan_buckets_and_bound(self, tmp_path):
+        tree = toy_tree()
+        src = place(tree, RULES["dp"], mesh_of("dp=8"))
+        ck = tmp_path / "ck"
+        checkpoint.save_sharded(
+            ck, src, step=0, partition={"rules": "dp", "axes": {"dp": 8}}
+        )
+        tmpl = reshard.target_templates(
+            tree, RULES["fsdp_row"], mesh_of("fsdp=8")
+        )
+        plan = reshard.plan_reshard(ck, tmpl, bucket_bytes=1 << 10)
+        assert plan.bytes_to_move > 0
+        assert plan.bound_bytes == 2 * plan.largest_bucket_bytes
+        # every multi-unit bucket respects the cap (a single unit larger
+        # than the cap gets a bucket of its own)
+        for bucket in plan.buckets:
+            total = sum(plan.units[j].nbytes for j in bucket)
+            assert len(bucket) == 1 or total <= 1 << 10
+        s = plan.summary()
+        assert s["units"] == len(plan.units)
+        assert s["bound_bytes"] == plan.bound_bytes
+
+    def test_transient_meter_enforces_bound(self):
+        m = mem_mod.TransientMeter(limit_bytes=100)
+        m.hold(60)
+        m.release(60)
+        m.hold(90)
+        assert m.peak == 90 and m.current == 90
+        with pytest.raises(mem_mod.MemoryBoundExceeded):
+            m.hold(20)
+        m.release(1000)
+        assert m.current == 0 and m.peak == 110
+
+    def test_reshard_event_validates_and_peak_bounded(
+        self, tmp_path, monkeypatch
+    ):
+        tdir = tmp_path / "telemetry"
+        monkeypatch.setenv(events.ENV_DIR, str(tdir))
+        monkeypatch.delenv(events.ENV_RUN_ID, raising=False)
+        tree = toy_tree()
+        src = place(tree, RULES["tp"], mesh_of("dp=2,tp=4"))
+        ck = tmp_path / "ck"
+        checkpoint.save_sharded(
+            ck, src, step=4, partition={"rules": "tp", "axes": {"dp": 2}}
+        )
+        tmpl = reshard.target_templates(
+            tree, RULES["fsdp_col"], mesh_of("dp=2,fsdp=2", 4)
+        )
+        reshard.redistribute(
+            ck, tmpl,
+            target_partition={"rules": "fsdp_col", "axes": {"dp": 2}},
+            bucket_bytes=1 << 10,
+        )
+        n, errors = events.validate_dir(tdir)
+        assert n >= 1 and not errors
+        recs = [
+            json.loads(line)
+            for f in tdir.glob("events*.jsonl")
+            for line in f.read_text().splitlines()
+        ]
+        ev = [r for r in recs if r["event"] == "reshard"]
+        assert len(ev) == 1
+        ev = ev[0]
+        assert ev["status"] == "ok"
+        assert ev["source"]["rules"] == "tp"
+        assert ev["target"]["rules"] == "fsdp_col"
+        assert ev["bytes_moved"] > 0
+        # the acceptance bound: peak transient bytes < 2x largest bucket
+        assert 0 < ev["peak_bytes"] <= ev["bound_bytes"]
+
+    def test_failed_reshard_emits_failed_event(self, tmp_path, monkeypatch):
+        tdir = tmp_path / "telemetry"
+        monkeypatch.setenv(events.ENV_DIR, str(tdir))
+        tree = toy_tree()
+        src = place(tree, RULES["dp"], mesh_of("dp=8"))
+        ck = tmp_path / "ck"
+        checkpoint.save_sharded(
+            ck, src, step=0, partition={"rules": "dp", "axes": {"dp": 8}}
+        )
+        (ck / "leaf_0").rename(ck / "leaf_0_gone")  # break it
+        tmpl = reshard.target_templates(
+            tree, RULES["dp"], mesh_of("dp=4", 4)
+        )
+        with pytest.raises(reshard.ReshardError):
+            reshard.redistribute(ck, tmpl)
+        recs = [
+            json.loads(line)
+            for f in tdir.glob("events*.jsonl")
+            for line in f.read_text().splitlines()
+        ]
+        ev = [r for r in recs if r["event"] == "reshard"]
+        assert ev and ev[-1]["status"] == "failed"
+        assert ev[-1]["failed_phase"] in ("verify", "stream")
+
+
+# --------------------------------------- checkpoint integrity satellites
+
+
+class TestShardedIntegrity:
+    def _save(self, tmp_path, name="ckpt_0", step=1):
+        tree = toy_tree()
+        src = place(tree, RULES["fsdp_row"], mesh_of("fsdp=8"))
+        ck = tmp_path / name
+        checkpoint.save_sharded(
+            ck, src, step=step,
+            partition={"rules": "fsdp_row", "axes": {"fsdp": 8}},
+        )
+        return ck
+
+    def test_blobs_carry_embedded_digest(self, tmp_path):
+        ck = self._save(tmp_path)
+        blob = next((ck / "leaf_0").glob("*.npz"))
+        with np.load(blob) as z:
+            assert "digest" in z.files
+            digest = bytes(z["digest"]).decode()
+            assert digest == checkpoint._blob_digest(z["data"].tobytes())
+        assert checkpoint._verify_blob(blob, np.dtype(np.float32))
+
+    def test_latest_intact_skips_missing_blob(self, tmp_path):
+        older = self._save(tmp_path, "ckpt_0", step=1)
+        newer = self._save(tmp_path, "ckpt_1", step=2)
+        assert checkpoint.latest_intact(tmp_path) == newer
+        next((newer / "leaf_1").glob("*.npz")).unlink()
+        assert checkpoint.latest_intact(tmp_path) == older
+
+    def test_latest_intact_skips_corrupt_digest(self, tmp_path):
+        older = self._save(tmp_path, "ckpt_0", step=1)
+        newer = self._save(tmp_path, "ckpt_1", step=2)
+        blob = sorted((newer / "leaf_0").glob("*.npz"))[0]
+        z = dict(np.load(blob))
+        z["data"] = z["data"].copy()
+        z["data"][-1] ^= 0x01
+        with open(blob, "wb") as fh:
+            np.savez(fh, **z)
+        assert checkpoint.latest_intact(tmp_path) == older
+
+    def test_latest_intact_skips_standing_marker(self, tmp_path):
+        older = self._save(tmp_path, "ckpt_0", step=1)
+        newer = self._save(tmp_path, "ckpt_1", step=2)
+        (newer / "save_inprogress.json").write_text(json.dumps({"step": 2}))
+        assert checkpoint.latest_intact(tmp_path) == older
+
+    def test_partition_mismatch_classification(self, tmp_path):
+        ck = self._save(tmp_path)
+        meta = checkpoint.read_meta(ck)
+        same = {"rules": "fsdp_row", "axes": {"fsdp": 8}}
+        assert checkpoint.partition_mismatch(meta, same) == []
+        resized = {"rules": "fsdp_row", "axes": {"fsdp": 4}}
+        assert checkpoint.partition_mismatch(meta, resized) == []  # resize
+        swapped = {"rules": "dp+fsdp", "axes": {"dp": 2, "fsdp": 4}}
+        problems = checkpoint.partition_mismatch(meta, swapped)
+        assert problems  # rule set AND axes differ
+        with pytest.raises(ValueError, match="reshard.redistribute"):
+            checkpoint.check_partition(meta, swapped)
+        with pytest.raises(ValueError, match="no partition metadata"):
+            checkpoint.partition_mismatch({"step": 0}, same)
+
+
+# ------------------------------------------------- chaos clause satellite
+
+
+class TestKillDuringCheckpoint:
+    def test_parse(self):
+        spec = chaos.parse("kill_during_checkpoint=3")
+        assert spec.kill_during_checkpoint == 3
+        with pytest.raises(ValueError, match="kill_during_checkpoint"):
+            chaos.parse("kill_during_checkpoint=0")
+
+    def test_kill_fires_after_n_blobs_and_leaves_partial_dir(
+        self, tmp_path, monkeypatch
+    ):
+        """The hook hard-exits after N blobs; routed through a
+        monkeypatched `kill_with_dump` so the partial directory (some
+        blobs present, marker standing, no meta) is inspectable
+        in-process — `latest_intact` must never select it."""
+
+        class Killed(BaseException):
+            pass
+
+        killed = []
+
+        def fake_kill(clause, code=17):
+            killed.append(clause)
+            raise Killed
+
+        monkeypatch.setattr(chaos, "kill_with_dump", fake_kill)
+        monkeypatch.setenv(chaos.ENV_VAR, "kill_during_checkpoint=2")
+        chaos.reset()
+        tree = toy_tree()
+        src = place(tree, RULES["fsdp_row"], mesh_of("fsdp=8"))
+        ck = tmp_path / "ckpt_0"
+        with pytest.raises(Killed):
+            checkpoint.save_sharded(ck, src, step=1)
+        assert killed == ["kill_during_checkpoint=2"]
+        assert (ck / "save_inprogress.json").exists()
+        assert not (ck / "meta.json").exists()
+        blobs = list(ck.glob("leaf_*/*.npz"))
+        assert len(blobs) == 2  # died right after the Nth blob
+        assert checkpoint.latest_intact(tmp_path) is None
+        # one-shot: a later save in the same process completes...
+        chaos.reset()
+        monkeypatch.delenv(chaos.ENV_VAR)
+        checkpoint.save_sharded(ck, src, step=1)
+        assert checkpoint.latest_intact(tmp_path) == ck
+        # ...and reset() re-arms the clause for the next test case
+        monkeypatch.setenv(chaos.ENV_VAR, "kill_during_checkpoint=1")
+        chaos.reset()
+        with pytest.raises(Killed):
+            checkpoint.save_sharded(tmp_path / "ckpt_1", src, step=2)
+
+
+# ------------------------------------------------- trainer resume routing
+
+
+class TestTrainerElasticResume:
+    def test_lm_trainer_routes_mismatch_to_reshard(self, tmp_path):
+        spec_a, spec_b = f"zero1:dp={N}", "dp=2,fsdp=4"
+        mesh_a = mesh_of(spec_a)
+        t = train.LMTrainer(
+            small_lm(), mesh_a, train.LMTrainConfig(mesh_axes=spec_a)
+        )
+        ck = tmp_path / "ck"
+        checkpoint.save_sharded(
+            ck, {"params": t.params, "opt_state": t.opt_state},
+            step=5, partition=t._partition_meta,
+        )
+        mesh_b = mesh_of(spec_b)
+        t2 = train.LMTrainer(
+            small_lm(), mesh_b, train.LMTrainConfig(mesh_axes=spec_b)
+        )
+        assert t2.restore(ck) == 5
+        assert_trees_equal(
+            part.gather_replicated(t.params, mesh_a),
+            part.gather_replicated(t2.params, mesh_b),
+        )
+
+    def test_reprobe_world_resolution(self, monkeypatch):
+        from tpu_dist.comm.launch import _reprobe_world
+
+        monkeypatch.delenv("TPU_DIST_PROBE_WORLD", raising=False)
+        assert _reprobe_world(None, 4) == 4  # nothing configured: replay
+        assert _reprobe_world(lambda: 2, 4) == 2  # probe wins
+        assert _reprobe_world(lambda: None, 4) == 4  # probe abstains
+        assert _reprobe_world(lambda: 0, 4) == 1  # clamped
+        monkeypatch.setenv("TPU_DIST_PROBE_WORLD", "3")
+        assert _reprobe_world(None, 4) == 3  # env honored
+        assert _reprobe_world(lambda: 2, 4) == 2  # probe beats env
+        monkeypatch.setenv("TPU_DIST_PROBE_WORLD", "garbage")
+        with pytest.raises(ValueError):
+            _reprobe_world(None, 4)  # a typo'd override must be loud
+
+
+# ------------------------------------------ chaos lane (make chaos-reshard)
+
+
+def _world_worker(rank, world):
+    """Cross-process observable for the elastic-relaunch test."""
+    return world
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosReshard:
+    """Kill mid-epoch, resume on a different mesh AND rule set, forward
+    bit-identical to the unkilled run — the acceptance scenario."""
+
+    @pytest.mark.parametrize(
+        "src_spec,tgt_spec,tgt_ndev",
+        [
+            (f"dp={N}", "dp=2,fsdp=4", N),      # dp -> dp.fsdp
+            ("dp=2,tp=4", "dp=2,fsdp=2", 4),     # dp.tp -> dp.fsdp, N -> M
+        ],
+    )
+    def test_kill_resume_other_mesh_bit_identical(
+        self, tmp_path, monkeypatch, src_spec, tgt_spec, tgt_ndev
+    ):
+        monkeypatch.delenv(chaos.ENV_VAR, raising=False)
+        windows = np.asarray(
+            np.random.default_rng(0).integers(0, 64, (32, 16)), np.int32
+        )
+        cfg = dict(epochs=2, global_batch=16, inflight_steps=0)
+        mesh_src = mesh_of(src_spec)
+
+        # Reference: the unkilled run (bit-deterministic per mesh/seed).
+        ref_dir = tmp_path / "ref"
+        t_ref = train.LMTrainer(
+            small_lm(), mesh_src,
+            train.LMTrainConfig(
+                mesh_axes=src_spec, log=lambda m: None, **cfg
+            ),
+        )
+        assert len(t_ref.fit(windows, checkpoint_dir=str(ref_dir))) == 2
+
+        # The killed run: SIGTERM lands after epoch 0's log line, the
+        # preemption guard checkpoints at the next step boundary.
+        def killer(msg):
+            if msg.startswith("epoch 0"):
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        kill_dir = tmp_path / "killed"
+        t_kill = train.LMTrainer(
+            small_lm(), mesh_src,
+            train.LMTrainConfig(mesh_axes=src_spec, log=killer, **cfg),
+        )
+        hist = t_kill.fit(windows, checkpoint_dir=str(kill_dir))
+        assert len(hist) == 1  # epoch 1 never completed
+
+        # Elastic resume on a DIFFERENT mesh shape and rule set.
+        mesh_tgt = mesh_of(tgt_spec, tgt_ndev)
+        t_tgt = train.LMTrainer(
+            small_lm(), mesh_tgt,
+            train.LMTrainConfig(
+                mesh_axes=tgt_spec, log=lambda m: None, **cfg
+            ),
+        )
+        found = checkpoint.latest_intact(kill_dir)
+        assert found is not None
+        resume_epoch = t_tgt.restore(found)
+        assert resume_epoch == 1
+
+        # Redistribution correctness at the actual resume point: the
+        # same checkpoint restored on the SOURCE mesh must gather to
+        # bit-identical state.
+        t_chk = train.LMTrainer(
+            small_lm(), mesh_src,
+            train.LMTrainConfig(
+                mesh_axes=src_spec, log=lambda m: None, **cfg
+            ),
+        )
+        t_chk.restore(found)
+        assert_trees_equal(
+            part.gather_replicated(t_chk.params, mesh_src),
+            part.gather_replicated(t_tgt.params, mesh_tgt),
+        )
+
+        # Bit-identity against the UNKILLED run, anchored at the shared
+        # epoch-0 checkpoint (both runs executed epoch 0 identically):
+        # redistribute the killed run's epoch checkpoint onto the target
+        # mesh and compare the forward bitwise.
+        t_anchor = train.LMTrainer(
+            small_lm(), mesh_tgt,
+            train.LMTrainConfig(
+                mesh_axes=tgt_spec, log=lambda m: None, **cfg
+            ),
+        )
+        assert t_anchor.restore(kill_dir / "lm_ckpt_0") == 1
+        t_ref2 = train.LMTrainer(
+            small_lm(), mesh_src,
+            train.LMTrainConfig(
+                mesh_axes=src_spec, log=lambda m: None, **cfg
+            ),
+        )
+        assert t_ref2.restore(ref_dir / "lm_ckpt_0") == 1
+        p_tgt = part.gather_replicated(t_anchor.params, mesh_tgt)
+        p_ref = part.gather_replicated(t_ref2.params, mesh_src)
+        assert_trees_equal(p_ref, p_tgt)
+        lm = small_lm()
+        fwd = jax.jit(lambda p, x: lm.apply(p, {}, x)[0])
+        toks = windows[:4]
+        logits_ref = np.asarray(
+            fwd(jax.tree.map(np.asarray, p_ref), toks)
+        )
+        logits_tgt = np.asarray(
+            fwd(jax.tree.map(np.asarray, p_tgt), toks)
+        )
+        np.testing.assert_array_equal(logits_ref, logits_tgt)
+
+        # ...and the resumed run completes on the new topology.
+        rest = t_tgt.fit(
+            windows, checkpoint_dir=str(tmp_path / "resumed"),
+            start_epoch=resume_epoch,
+        )
+        assert [h.epoch for h in rest] == [1]
+
+    def test_launch_reprobes_world_on_relaunch(self, tmp_path, monkeypatch):
+        """A rank killed at launch attempt 0, restarts=1: the supervisor
+        re-probes the world (env override: one chip lost) and relaunches
+        with the NEW topology; the supervisor event records it."""
+        from tpu_dist.comm import launch
+
+        tdir = tmp_path / "telemetry"
+        monkeypatch.setenv(events.ENV_DIR, str(tdir))
+        monkeypatch.delenv(events.ENV_RUN_ID, raising=False)
+        monkeypatch.setenv(chaos.ENV_VAR, "kill=1")
+        monkeypatch.setenv("TPU_DIST_PROBE_WORLD", "1")
+        res = launch(
+            _world_worker, 2, platform="cpu", timeout=240.0, restarts=1
+        )
+        assert res == [1]  # the relaunch ran the re-probed world
+        sup = tdir / "events_supervisor.jsonl"
+        recs = [json.loads(x) for x in sup.read_text().splitlines()]
+        retries = [r for r in recs if r["event"] == "retry"]
+        assert retries[0]["outcome"] == "relaunching"
+        assert retries[0]["world"] == 2
+        assert retries[0]["relaunch_world"] == 1
+        assert retries[-1]["outcome"] == "succeeded"
+        assert retries[-1]["relaunch_world"] == 1
+        n, errors = events.validate_dir(tdir)
+        assert n >= 2 and not errors

@@ -98,6 +98,7 @@ def launch(
     init_method: str | None = None,
     assign_ranks: bool = True,
     restarts: int = 0,
+    probe_world: Callable[[], int | None] | None = None,
 ) -> list[Any]:
     """Fork-join ``world`` processes running ``fn(rank, world)``.
 
@@ -120,6 +121,20 @@ def launch(
     (`resilience.chaos.ATTEMPT_ENV_VAR`) so chaos kill clauses can be
     scoped to one attempt.  Exhausted restarts raise
     `resilience.WorkerFailed` with the last failure.
+
+    ``probe_world`` makes the relaunch ELASTIC: before each relaunch the
+    supervisor re-probes how many workers the machine can actually field
+    (a preemption may have taken chips with it) instead of replaying the
+    original world size — the callable returns the new world (None =
+    keep the current one).  Without it, the env var
+    ``TPU_DIST_PROBE_WORLD`` (an integer, read fresh per relaunch) is
+    honored, else the world is replayed unchanged.  Each supervisor
+    event carries ``relaunch_world`` — the world the NEXT attempt will
+    run (None once restarts are exhausted) — so the event stream shows
+    the topology change next to the failure that forced it.  Elastic
+    workloads resume their checkpoints through
+    `train.reshard.redistribute`, which maps the old topology's shards
+    onto whatever mesh the re-probed world builds.
     """
     from tpu_dist.observe import events as events_mod
     from tpu_dist.resilience.retry import WorkerFailed, logger
@@ -129,10 +144,11 @@ def launch(
     # of vanishing into stderr.  NULL logger when telemetry is off.
     elog = events_mod.from_env(role="supervisor")
     last_error: Exception | None = None
+    attempt_world = world
     for attempt in range(restarts + 1):
         try:
             results = _launch_once(
-                fn, world, platform=platform, addr=addr, port=port,
+                fn, attempt_world, platform=platform, addr=addr, port=port,
                 devices_per_proc=devices_per_proc, timeout=timeout,
                 init_method=init_method, assign_ranks=assign_ranks,
                 attempt=attempt,
@@ -140,7 +156,8 @@ def launch(
             if attempt > 0:
                 elog.emit(
                     "retry", what="gang_relaunch", attempt=attempt + 1,
-                    max_attempts=restarts + 1, error=None, world=world,
+                    max_attempts=restarts + 1, error=None,
+                    world=attempt_world, relaunch_world=attempt_world,
                     outcome="succeeded",
                 )
             return results
@@ -154,19 +171,47 @@ def launch(
             # tpu_dist.observe.flightrec merge <dir>` names the
             # divergent rank from the gathered set.
             _gather_flight_dumps(elog, attempt)
+            exhausted = attempt >= restarts
+            next_world = (
+                None if exhausted
+                else _reprobe_world(probe_world, attempt_world)
+            )
             elog.emit(
                 "retry", what="gang_relaunch", attempt=attempt + 1,
-                max_attempts=restarts + 1, error=str(e), world=world,
-                outcome="exhausted" if attempt >= restarts else "relaunching",
+                max_attempts=restarts + 1, error=str(e),
+                world=attempt_world, relaunch_world=next_world,
+                outcome="exhausted" if exhausted else "relaunching",
             )
-            if attempt >= restarts:
+            if exhausted:
                 break
+            if next_world != attempt_world:
+                logger.warning(
+                    "elastic relaunch: world %d -> %d (re-probed)",
+                    attempt_world, next_world,
+                )
+            attempt_world = next_world
             logger.warning(
                 "launch attempt %d/%d failed (%s); relaunching the gang",
                 attempt + 1, restarts + 1, e,
             )
     assert last_error is not None
     raise last_error
+
+
+def _reprobe_world(
+    probe_world: Callable[[], int | None] | None, current: int
+) -> int:
+    """The world size the next relaunch attempt should run.  A probe
+    callable wins (its errors propagate — a broken probe must be loud);
+    else ``TPU_DIST_PROBE_WORLD`` (garbage raises, same reasoning); else
+    the current world, unchanged.  Clamped to >= 1."""
+    if probe_world is not None:
+        probed = probe_world()
+        return max(1, int(probed)) if probed is not None else current
+    env = os.environ.get("TPU_DIST_PROBE_WORLD")
+    if env is not None:
+        return max(1, int(env))
+    return current
 
 
 def _gather_flight_dumps(elog, attempt: int) -> None:

@@ -117,6 +117,15 @@ SCHEMA: dict[str, tuple[str, ...]] = {
         "source", "bytes_in_use", "peak_bytes_in_use", "bytes_limit",
         "phases",
     ),
+    # elastic resume (train.reshard.redistribute): one record per
+    # redistribution — source/target partition provenance, bytes this
+    # rank streamed off disk, the transient staging peak the memory
+    # bound was asserted on (observe.memory.TransientMeter), wall time,
+    # and "ok" | "failed"
+    "reshard": (
+        "source", "target", "bytes_moved", "peak_bytes", "seconds",
+        "status",
+    ),
     # OOM forensics (observe.memory.record_oom): RESOURCE_EXHAUSTED on
     # a step path — the failing phase, the headroom at failure, and the
     # largest resident class; the full report rides the flight dump

@@ -43,9 +43,11 @@ from tpu_dist.observe import events as _events
 from tpu_dist.observe import flightrec as _flightrec
 
 # The phase vocabulary the sampler buckets watermark deltas into — the
-# union of the trainer span phases and the serve engine's step halves.
+# union of the trainer span phases, the serve engine's step halves, and
+# the elastic-resume redistribution (`train.reshard`).
 PHASES = (
     "data", "dispatch", "readback", "checkpoint", "prefill", "decode",
+    "reshard",
 )
 
 _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
@@ -221,6 +223,46 @@ class WatermarkSampler:
         """Emit the required ``memory`` telemetry event."""
         log = logger if logger is not None else _events.from_env()
         return log.emit("memory", **self.summary())
+
+
+class MemoryBoundExceeded(RuntimeError):
+    """An explicitly-accounted transient exceeded its configured bound —
+    a broken streaming plan (a bug), not an organic OOM."""
+
+
+class TransientMeter:
+    """Exact accounting of TRANSIENT host bytes for a bounded streaming
+    operation (the elastic-resume redistribution, `train.reshard`).
+
+    RSS cannot isolate transient overhead on the CPU-sim: the target
+    device buffers land in the same process RSS as the staging buffers,
+    so "never materialize a full replica" must be asserted on an
+    explicit counter — `hold` on staging-buffer allocation, `release`
+    after hand-off to the device.  With ``limit_bytes`` set, crossing
+    the bound raises `MemoryBoundExceeded` at the exact allocation that
+    broke it.  Pair with a `WatermarkSampler` for the ambient watermark
+    (the `reshard` event reports both)."""
+
+    def __init__(self, limit_bytes: int | None = None, *,
+                 what: str = "reshard"):
+        self.limit_bytes = limit_bytes
+        self.what = what
+        self.current = 0
+        self.peak = 0
+
+    def hold(self, nbytes: int) -> None:
+        self.current += int(nbytes)
+        if self.current > self.peak:
+            self.peak = self.current
+        if self.limit_bytes is not None and self.current > self.limit_bytes:
+            raise MemoryBoundExceeded(
+                f"{self.what}: transient host bytes ({self.current}) "
+                f"exceed the configured bound ({self.limit_bytes}) — the "
+                "streaming bucket plan is broken"
+            )
+
+    def release(self, nbytes: int) -> None:
+        self.current = max(0, self.current - int(nbytes))
 
 
 # ------------------------------------------------------------ OOM forensics

@@ -26,6 +26,14 @@ Clause grammar (all values integers/floats; unknown clauses raise):
     ckpt_truncate=FRAC   truncate the NEXT checkpoint file this process
                          writes to FRAC of its bytes (one-shot) — a
                          mid-write kill, for `checkpoint.latest_intact`
+    kill_during_checkpoint=N
+                         hard-exit (``os._exit``) after this process has
+                         written N shard blobs of its NEXT
+                         ``save_sharded`` (one-shot) — the partial
+                         sharded DIRECTORY a preemption mid-save leaves
+                         behind (some blobs present, the attempt marker
+                         still standing); `checkpoint.latest_intact`
+                         must never select it for resume
     seed=N               seed recorded on the spec for any randomized
                          knobs (reserved; injection is deterministic)
 
@@ -59,6 +67,7 @@ class ChaosSpec:
     delay: dict[int, float] = field(default_factory=dict)  # rank -> seconds
     nan_step: int | None = None
     ckpt_truncate: float | None = None
+    kill_during_checkpoint: int | None = None
     seed: int = 0
 
 
@@ -67,6 +76,7 @@ def parse(spec: str) -> ChaosSpec:
     malformed values — a typo'd chaos spec must fail loudly, not silently
     inject nothing."""
     rdzv_fail, nan_step, ckpt_truncate, seed = 0, None, None, 0
+    kill_during_ckpt: int | None = None
     kill: dict[int, int] = {}
     delay: dict[int, float] = {}
     for clause in spec.split(","):
@@ -93,6 +103,12 @@ def parse(spec: str) -> ChaosSpec:
                 ckpt_truncate = float(value)
                 if not 0.0 <= ckpt_truncate < 1.0:
                     raise ValueError("ckpt_truncate must be in [0, 1)")
+            elif key == "kill_during_checkpoint":
+                kill_during_ckpt = int(value)
+                if kill_during_ckpt < 1:
+                    raise ValueError(
+                        "kill_during_checkpoint needs N >= 1 blobs"
+                    )
             elif key == "seed":
                 seed = int(value)
             else:
@@ -103,7 +119,8 @@ def parse(spec: str) -> ChaosSpec:
             ) from None
     return ChaosSpec(
         rdzv_fail=rdzv_fail, kill=kill, delay=delay, nan_step=nan_step,
-        ckpt_truncate=ckpt_truncate, seed=seed,
+        ckpt_truncate=ckpt_truncate, kill_during_checkpoint=kill_during_ckpt,
+        seed=seed,
     )
 
 
@@ -211,6 +228,35 @@ def maybe_truncate_checkpoint(path) -> bool:
     return True
 
 
+_kill_ckpt_armed = True
+
+
+def checkpoint_blob_written(written: int, total: int) -> None:
+    """One-shot hook called by `train.checkpoint._write_sharded` after
+    each shard blob lands: with ``kill_during_checkpoint=N``, hard-exit
+    once N blobs are written (clamped to this process's blob count, so
+    the clause always fires mid-save) — exercising the partial sharded
+    directory `checkpoint.latest_intact` must skip."""
+    global _kill_ckpt_armed
+    spec = active()
+    if (
+        spec is None
+        or spec.kill_during_checkpoint is None
+        or not _kill_ckpt_armed
+    ):
+        return
+    if written >= min(spec.kill_during_checkpoint, total):
+        _kill_ckpt_armed = False
+        clause = f"kill_during_checkpoint={spec.kill_during_checkpoint}"
+        try:
+            rank = int(os.environ.get("TPU_DIST_TELEMETRY_RANK")
+                       or os.environ.get("RANK") or 0)
+        except ValueError:
+            rank = 0
+        _emit_chaos_event(clause, rank)
+        kill_with_dump(clause)
+
+
 def truncate_file(path, frac: float = 0.5) -> None:
     """Truncate ``path`` to ``frac`` of its bytes — the on-disk state a
     preemption mid-write leaves behind."""
@@ -222,5 +268,6 @@ def truncate_file(path, frac: float = 0.5) -> None:
 
 def reset() -> None:
     """Re-arm one-shot injections (tests run many cases per process)."""
-    global _truncate_armed
+    global _truncate_armed, _kill_ckpt_armed
     _truncate_armed = True
+    _kill_ckpt_armed = True

@@ -206,6 +206,32 @@ class AsyncCheckpointer:
 # reads only the bytes its devices need.
 
 
+def _blob_digest(raw: bytes) -> str:
+    """sha256 of one shard blob's raw bytes — embedded in the blob file
+    itself (see `_write_sharded`) so every process's shards are
+    independently verifiable without a global digest pass."""
+    import hashlib
+
+    return hashlib.sha256(raw).hexdigest()
+
+
+def _verify_blob(file: Path, dtype: np.dtype) -> bool:
+    """One shard blob is present, sized to its recorded shape, and —
+    when it carries an embedded digest (every blob written since elastic
+    resume landed) — byte-identical to what was written.  Digest-less
+    legacy blobs pass on the size check alone.  Never raises."""
+    try:
+        with np.load(file) as z:
+            data, shape = z["data"], z["shape"]
+            if data.size != int(np.prod(shape)) * dtype.itemsize:
+                return False
+            if "digest" in z.files:
+                return _blob_digest(data.tobytes()) == bytes(z["digest"]).decode()
+        return True
+    except Exception:
+        return False
+
+
 def _norm_index(index: tuple, shape: tuple[int, ...]) -> tuple[tuple[int, int], ...]:
     """Normalize a shard index (tuple of slices, possibly fewer than ndim
     and with None bounds) to per-dim (start, stop) over ``shape``."""
@@ -390,7 +416,9 @@ def _write_sharded(
                     "overwrite blobs a live meta may still reference"
                 )
             time.sleep(0.05)
-    for rel, shape, raw in blobs:
+    from tpu_dist.resilience import chaos as _chaos
+
+    for blob_i, (rel, shape, raw) in enumerate(blobs):
         f = path / rel
         f.parent.mkdir(parents=True, exist_ok=True)
         tmp = f.with_name(f.name + ".tmp")
@@ -398,11 +426,23 @@ def _write_sharded(
         # dtypes (bfloat16, fp8) as raw void, losing the dtype — bytes +
         # meta dtype is lossless for every dtype.  Write via a handle:
         # np.savez appends ".npz" to bare paths, breaking the tmp-rename.
+        # Each blob embeds its own sha256 (`digest`): the shard table in
+        # meta.json is written by process 0, which never sees the other
+        # processes' bytes, so per-shard integrity must travel with the
+        # shard file itself (`_verify_blob`, and the reshard engine's
+        # verify-before-commit pass).
         with open(tmp, "wb") as fh:
             np.savez(
-                fh, data=np.frombuffer(raw, np.uint8), shape=np.asarray(shape, np.int64)
+                fh,
+                data=np.frombuffer(raw, np.uint8),
+                shape=np.asarray(shape, np.int64),
+                digest=np.frombuffer(_blob_digest(raw).encode(), np.uint8),
             )
         tmp.rename(f)
+        # Chaos (`TPU_DIST_CHAOS=kill_during_checkpoint=N`): hard-exit
+        # after the Nth blob — the partial sharded directory a real
+        # preemption mid-save leaves behind.  No-op when chaos is off.
+        _chaos.checkpoint_blob_written(blob_i + 1, len(blobs))
     if jax.process_index() == 0:
         # Publish meta.json only once every shard file it references is
         # visible (multi-host: other processes write their own blobs to
@@ -569,15 +609,22 @@ def read_meta(path: str | Path) -> dict:
     return json.loads((Path(path) / "meta.json").read_text())
 
 
-def check_partition(
+def partition_mismatch(
     meta: dict, expected: dict, *, where: str = "checkpoint"
-) -> None:
-    """Validate a checkpoint's recorded partition provenance against the
-    restoring run's resolved rule set + mesh (both in
-    `parallel.partition_summary` form).  Mismatches raise a clear error
-    instead of the silent mis-shard a blind restore would risk — the
-    groundwork for elastic resume (ROADMAP item 3): a reshape across
-    topologies must be an explicit redistribution, not an accident."""
+) -> list[str]:
+    """The incompatibilities between a checkpoint's recorded partition
+    provenance and the restoring run's resolved rule set + mesh (both in
+    `parallel.partition_summary` form).
+
+    An empty list means the checkpoint restores directly (identical
+    provenance, or a same-rules/same-axis-name world resize —
+    `restore_sharded` handles that natively: engine checkpoints store
+    logical-shape leaves, and per-rank state like the EF residual is
+    shape-checked and reset separately by
+    `compress.reset_resized_residual`).  A non-empty list is the elastic
+    resume case: different rule set or topology, routed through
+    `train.reshard.redistribute` by the engine trainers.  Raises only
+    when the checkpoint carries no provenance at all."""
     saved = meta.get("partition")
     if saved is None:
         raise ValueError(
@@ -597,23 +644,33 @@ def check_partition(
             f"rule set {saved.get('rules')!r} (saved) vs "
             f"{expected.get('rules')!r} (this run)"
         )
-    # Same rule set on the same AXIS NAMES but different sizes is a
-    # world resize: engine checkpoints store logical-shape leaves, so
-    # `restore_sharded` reshards them natively (per-rank state like the
-    # EF residual is shape-checked and reset separately,
-    # `compress.reset_resized_residual`).  Different axis NAMES mean a
-    # different topology — that is the elastic-resume case below.
     if tuple(saved_axes) != tuple(want_axes):
         problems.append(
             f"mesh axes {saved_axes} (saved) vs {want_axes} (this run)"
         )
+    return problems
+
+
+def check_partition(
+    meta: dict, expected: dict, *, where: str = "checkpoint"
+) -> None:
+    """Validate a checkpoint's recorded partition provenance against the
+    restoring run's resolved rule set + mesh (both in
+    `parallel.partition_summary` form).  Mismatches raise a clear error
+    instead of the silent mis-shard a blind restore would risk; callers
+    that want to HANDLE the mismatch (the engine trainers' elastic
+    resume) use `partition_mismatch` and route to
+    `train.reshard.redistribute` instead."""
+    problems = partition_mismatch(meta, expected, where=where)
     if problems:
         raise ValueError(
             f"{where}: partition mismatch — " + "; ".join(problems)
-            + ".  Resharding across meshes is not automatic yet "
-            "(ROADMAP item 3, elastic resume); restore on a matching "
-            "mesh_axes configuration or redistribute the checkpoint "
-            "explicitly via restore_sharded with the new shardings."
+            + ".  Redistribute the checkpoint onto this run's mesh and "
+            "rule set with tpu_dist.train.reshard.redistribute (elastic "
+            "resume: saved shards are streamed onto the new "
+            "PartitionSpecs in memory-bounded buckets) — the "
+            "partition-engine trainers' restore() routes there "
+            "automatically."
         )
 
 
@@ -764,21 +821,27 @@ def _inspect(path: Path) -> int | None:
 
     ``.npz`` files: the archive must parse, every referenced leaf must be
     present, and the stored digest (when present) must match the bytes.
-    Sharded DIRECTORY checkpoints: ``meta.json`` must parse and every
-    referenced shard blob must load with its recorded shape.  Any failure
-    mode — truncation, a missing shard, bit rot under the digest — maps
-    to None, never an exception."""
+    Sharded DIRECTORY checkpoints: no in-progress attempt marker may be
+    standing (a kill mid-``save_sharded`` leaves it), ``meta.json`` must
+    parse, every referenced shard blob must verify (`_verify_blob`:
+    size + embedded sha256), and per leaf the shards must account for
+    the full domain — so a kill mid-sharded-write can never be selected
+    for resume.  Any failure mode — truncation, a missing shard, bit
+    rot under the digest — maps to None, never an exception."""
     try:
         if path.is_dir():
+            if (path / "save_inprogress.json").exists():
+                return None  # a save attempt died (or is live) mid-write
             meta = read_meta(path)
             for i, rec in enumerate(meta["leaves"]):
+                dtype = np.dtype(rec["dtype"])
+                covered = 0
                 for shard in rec["shards"]:
-                    with np.load(path / f"leaf_{i}" / shard["file"]) as z:
-                        data, shape = z["data"], z["shape"]
-                        if data.size != int(np.prod(shape)) * (
-                            np.dtype(rec["dtype"]).itemsize
-                        ):
-                            return None
+                    if not _verify_blob(path / f"leaf_{i}" / shard["file"], dtype):
+                        return None
+                    covered += int(np.prod(shard["shape"]))
+                if covered != int(np.prod(rec["shape"])):
+                    return None  # shards do not tile the leaf's domain
             return int(meta["step"])
         with np.load(path, allow_pickle=False) as data:
             meta = json.loads(str(data["__meta__"]))

@@ -804,15 +804,20 @@ class LMTrainer:
         like = {"params": self.params, "opt_state": self.opt_state}
         if self._sharded_ckpt:
             if self._ruleset is not None:
-                # Engine mode: a checkpoint from a different rule set or
-                # mesh must fail loudly, not flat-copy into garbage.
-                checkpoint.check_partition(
-                    checkpoint.read_meta(path), self._partition_meta,
+                # Engine mode: elastic resume.  Compatible provenance
+                # restores directly; a different rule set or topology is
+                # redistributed onto this run's shardings in
+                # memory-bounded buckets (train.reshard).
+                from tpu_dist.train import reshard as reshard_mod
+
+                state, epoch, _ = reshard_mod.restore_or_redistribute(
+                    path, like, self._partition_meta,
                     where=f"restore({path})",
                 )
-            # Rebuilt under the templates' shardings — replicated leaves
-            # come back replicated, the EF residual comes back P(data).
-            state, epoch = checkpoint.restore_fsdp(path, like)
+            else:
+                # Rebuilt under the templates' shardings — replicated
+                # leaves come back replicated, fsdp leaves row-sharded.
+                state, epoch = checkpoint.restore_fsdp(path, like)
             self.params = state["params"]
             # A different-world-size checkpoint flat-copies fsdp rows
             # validly (zero padding) but would misdirect the dense

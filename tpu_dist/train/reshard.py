@@ -1,0 +1,642 @@
+"""Elastic resume: memory-bounded checkpoint redistribution.
+
+`checkpoint.restore_sharded` already reads arbitrary REGIONS of saved
+leaves, so a same-rules world resize restores in place.  What it cannot
+do is change the PARTITIONING itself — resume a dp=8 checkpoint on a
+dp=2,fsdp=4 mesh, or a dp×tp run on dp×fsdp after a preemption returned
+a different slice.  This module closes that gap with the redistribution
+scheme of "Memory-efficient array redistribution" (arxiv 2112.01075)
+adapted to the resume path: instead of all-to-all slice exchange between
+live ranks, the saved shard files ARE the source layout, and each rank
+streams exactly the regions its own target shards need, in bounded
+buckets, never materializing a full replica of any leaf.
+
+The phases (each start is a flight-ring mark, so a redistribution that
+dies is post-mortem-debuggable like any collective):
+
+  plan    map the template's target shardings onto the saved leaf
+          domains: one transfer UNIT per unique target region (replicas
+          of a region share the unit), greedy-packed into buckets of at
+          most ``bucket_bytes``
+  verify  integrity before any byte moves: every shard blob intersecting
+          a needed region must pass `checkpoint._verify_blob` (embedded
+          sha256); npz sources re-hash against the tree digest
+  stream  per bucket, per unit: read the region from the intersecting
+          shard files (`checkpoint._read_region`), place it on every
+          device that needs it, release the staging buffer.  Transient
+          host bytes are accounted EXACTLY by `observe.memory.
+          TransientMeter` with the bound ``2 × largest bucket`` —
+          crossing it raises instead of silently ballooning
+  commit  assemble `jax.Array` leaves from the placed per-device shards
+          (`jax.make_array_from_single_device_arrays`), unflatten, emit
+          the validated ``reshard`` telemetry event
+
+Shape-mismatched leaves (per-rank state like the error-feedback
+residual, whose physical shape is a function of the rule set) cannot be
+redistributed meaningfully; with ``on_shape_mismatch="reset"`` (the
+default, matching `compress.reset_resized_residual` semantics) they are
+zero-initialized under the target sharding and reported in the event.
+
+Entry points: `redistribute` (the engine), `restore_or_redistribute`
+(the trainers' resume route: direct restore when
+`checkpoint.partition_mismatch` is empty, redistribution otherwise),
+`target_templates` (build the target-sharding template tree from
+partition rules + mesh for standalone use).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from tpu_dist.train import checkpoint
+
+# Default streaming granularity.  64 MiB mirrors the bucket sizing of
+# the gradient-bucketing path (comm.bucketing): large enough that file
+# IO amortizes, small enough that 2× the bucket is far below any leaf
+# of interest at scale.
+DEFAULT_BUCKET_BYTES = 64 << 20
+
+
+class ReshardError(RuntimeError):
+    """A redistribution failed.  ``phase`` names the phase that died
+    ("plan" / "verify" / "stream" / "commit") — the same phase the
+    flight-ring trail ends with."""
+
+    def __init__(self, message: str, *, phase: str = "plan"):
+        super().__init__(message)
+        self.phase = phase
+
+
+@dataclass(frozen=True)
+class _Unit:
+    """One transfer unit: a half-open region of one leaf, destined for
+    one or more devices (replicas of the region share the unit — the
+    region is read once and placed per device)."""
+
+    leaf: int
+    keypath: str
+    bounds: tuple[tuple[int, int], ...]
+    nbytes: int
+    devices: tuple  # target devices; empty = host (numpy) leaf
+
+
+@dataclass
+class ReshardPlan:
+    """The full redistribution plan for one (checkpoint, template) pair
+    — inspectable before any byte moves (`plan_reshard`)."""
+
+    path: Path
+    step: int
+    source: dict | None  # saved partition provenance (may be None)
+    npz: bool
+    units: list[_Unit]
+    buckets: list[list[int]]  # indices into units
+    reset_leaves: dict[int, str]  # leaf index -> keypath (zero-init)
+    bytes_to_move: int
+    bucket_bytes: int
+    largest_bucket_bytes: int
+
+    @property
+    def bound_bytes(self) -> int:
+        """The asserted transient-host-bytes ceiling: 2× the largest
+        bucket (read-ahead of one bucket plus the buffers mid-handoff;
+        for npz sources the one-leaf decompression cache is folded into
+        the largest-bucket figure)."""
+        return 2 * max(self.largest_bucket_bytes, 1)
+
+    def summary(self) -> dict:
+        return {
+            "step": self.step,
+            "units": len(self.units),
+            "buckets": len(self.buckets),
+            "bytes_to_move": self.bytes_to_move,
+            "bucket_bytes": self.bucket_bytes,
+            "largest_bucket_bytes": self.largest_bucket_bytes,
+            "bound_bytes": self.bound_bytes,
+            "leaves_reset": sorted(self.reset_leaves.values()),
+        }
+
+
+def _leaf_shape_dtype(tmpl: Any) -> tuple[tuple[int, ...], np.dtype]:
+    if hasattr(tmpl, "shape") and hasattr(tmpl, "dtype"):
+        return tuple(int(d) for d in tmpl.shape), np.dtype(tmpl.dtype)
+    arr = np.asarray(tmpl)
+    return tuple(arr.shape), arr.dtype
+
+
+def _leaf_sharding(tmpl: Any):
+    """The target sharding of a template leaf, or None for host leaves
+    (numpy / python scalars, restored as fully-assembled numpy)."""
+    import jax
+
+    if isinstance(tmpl, jax.Array):
+        return tmpl.sharding
+    return getattr(tmpl, "sharding", None)  # ShapeDtypeStruct carries it
+
+
+def _npz_leaf_headers(path: Path, n: int) -> list[tuple[tuple, np.dtype]]:
+    """(shape, dtype) per npz leaf WITHOUT decompressing the data — the
+    npy header inside the zip member carries both.  Falls back to full
+    decompression (one leaf at a time) if the private header reader
+    moves in a future numpy."""
+    import zipfile
+
+    from numpy.lib import format as npfmt
+
+    out = []
+    try:
+        with zipfile.ZipFile(path) as zf:
+            for i in range(n):
+                with zf.open(f"leaf_{i}.npy") as fh:
+                    version = npfmt.read_magic(fh)
+                    shape, _fortran, dtype = npfmt._read_array_header(
+                        fh, version
+                    )
+                out.append((tuple(int(d) for d in shape), np.dtype(dtype)))
+        return out
+    except (AttributeError, TypeError):
+        out = []
+        with np.load(path, allow_pickle=False) as z:
+            for i in range(n):
+                arr = z[f"leaf_{i}"]
+                out.append((tuple(arr.shape), arr.dtype))
+                del arr
+        return out
+
+
+def _load_source_meta(path: Path) -> tuple[dict, bool]:
+    """Normalize either checkpoint format to sharded-dir meta shape:
+    ``{"step", "partition"?, "leaves": [{"path","shape","dtype"}...]}``
+    (npz leaves carry no shard table — the whole leaf is one region)."""
+    if path.is_dir():
+        return checkpoint.read_meta(path), False
+    with np.load(path, allow_pickle=False) as z:
+        raw = json.loads(str(z["__meta__"]))
+    headers = _npz_leaf_headers(path, len(raw["paths"]))
+    leaves = [
+        {"path": keypath, "shape": list(shape), "dtype": dtype.name}
+        for keypath, (shape, dtype) in zip(raw["paths"], headers, strict=True)
+    ]
+    meta = {"step": raw["step"], "leaves": leaves, "digest": raw.get("digest")}
+    if "partition" in raw:
+        meta["partition"] = raw["partition"]
+    return meta, True
+
+
+def _npz_dtype_view(arr: np.ndarray, want: np.dtype) -> np.ndarray:
+    """npz round-trips extension dtypes (bfloat16/fp8) as raw void with
+    the same bytes — re-view them as the template dtype.  A genuine
+    dtype mismatch still raises upstream (plan phase compares names)."""
+    if arr.dtype != want and arr.dtype.kind == "V" \
+            and arr.dtype.itemsize == want.itemsize:
+        return arr.view(want)
+    return arr
+
+
+def plan_reshard(
+    path: str | Path,
+    like: Any,
+    *,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    on_shape_mismatch: str = "reset",
+) -> ReshardPlan:
+    """Build the redistribution plan: per-unique-target-region transfer
+    units, greedy-packed into buckets, plus the leaves that must be
+    zero-reset (template shape differs from the saved shape — per-rank
+    state whose physical layout is a function of the rule set)."""
+    path = Path(path)
+    meta, npz = _load_source_meta(path)
+    return _plan_from_meta(
+        path, meta, npz, like,
+        bucket_bytes=bucket_bytes, on_shape_mismatch=on_shape_mismatch,
+    )
+
+
+def _plan_from_meta(
+    path: Path,
+    meta: dict,
+    npz: bool,
+    like: Any,
+    *,
+    bucket_bytes: int,
+    on_shape_mismatch: str,
+) -> ReshardPlan:
+    leaves_like, _ = checkpoint._flatten_with_paths(like)
+    saved_paths = [rec["path"] for rec in meta["leaves"]]
+    if [k for k, _ in leaves_like] != saved_paths:
+        raise ValueError(
+            f"reshard source {path} structure mismatch: "
+            f"{saved_paths[:3]}... vs {[k for k, _ in leaves_like][:3]}..."
+        )
+    units: list[_Unit] = []
+    reset_leaves: dict[int, str] = {}
+    largest_leaf = 0
+    for i, ((keypath, tmpl), rec) in enumerate(
+        zip(leaves_like, meta["leaves"], strict=True)
+    ):
+        t_shape, t_dtype = _leaf_shape_dtype(tmpl)
+        s_shape, s_dtype = tuple(rec["shape"]), np.dtype(rec["dtype"])
+        if t_shape != tuple(s_shape):
+            if on_shape_mismatch != "reset":
+                raise ValueError(
+                    f"leaf {keypath}: saved shape {tuple(s_shape)} vs "
+                    f"template shape {t_shape} (on_shape_mismatch="
+                    f"{on_shape_mismatch!r})"
+                )
+            reset_leaves[i] = keypath
+            continue
+        if s_dtype != t_dtype:
+            raise ValueError(
+                f"leaf {keypath}: saved dtype {s_dtype} vs template "
+                f"dtype {t_dtype} — redistribution never casts"
+            )
+        sharding = _leaf_sharding(tmpl)
+        if sharding is None:
+            nbytes = int(np.prod(t_shape, dtype=np.int64)) * t_dtype.itemsize
+            units.append(
+                _Unit(i, keypath, tuple((0, d) for d in t_shape),
+                      int(nbytes), ())
+            )
+            largest_leaf = max(largest_leaf, int(nbytes))
+            continue
+        # One unit per unique target region on THIS process's devices;
+        # replicas (several devices, same region) share the unit.
+        addressable = set(sharding.addressable_devices)
+        indices = sharding.devices_indices_map(t_shape)
+        regions: dict[tuple, list] = {}
+        for dev in sorted(addressable, key=lambda d: d.id):
+            bounds = checkpoint._norm_index(indices[dev], t_shape)
+            regions.setdefault(bounds, []).append(dev)
+        for bounds, devs in regions.items():
+            n = int(np.prod([hi - lo for lo, hi in bounds], dtype=np.int64)
+                    ) if bounds else 1
+            units.append(
+                _Unit(i, keypath, bounds, n * t_dtype.itemsize, tuple(devs))
+            )
+        nbytes = int(np.prod(t_shape, dtype=np.int64)) * t_dtype.itemsize
+        largest_leaf = max(largest_leaf, int(nbytes))
+    # Greedy packing in leaf order (units of one leaf stay adjacent —
+    # the npz reader's one-leaf cache relies on it).
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_bytes = 0
+    largest = 0
+    for j, u in enumerate(units):
+        if cur and cur_bytes + u.nbytes > bucket_bytes:
+            buckets.append(cur)
+            largest = max(largest, cur_bytes)
+            cur, cur_bytes = [], 0
+        cur.append(j)
+        cur_bytes += u.nbytes
+    if cur:
+        buckets.append(cur)
+        largest = max(largest, cur_bytes)
+    if npz:
+        # The decompression cache holds one full leaf at a time.
+        largest = max(largest, largest_leaf)
+    return ReshardPlan(
+        path=path,
+        step=int(meta["step"]),
+        source=meta.get("partition"),
+        npz=npz,
+        units=units,
+        buckets=buckets,
+        reset_leaves=reset_leaves,
+        bytes_to_move=sum(u.nbytes for u in units),
+        bucket_bytes=bucket_bytes,
+        largest_bucket_bytes=largest,
+    )
+
+
+def _intersects(shard: dict, bounds: tuple[tuple[int, int], ...]) -> bool:
+    return all(
+        max(int(o), lo) < min(int(o) + int(s), hi)
+        for (lo, hi), o, s in zip(bounds, shard["offset"], shard["shape"])
+    )
+
+
+def _verify_source(path: Path, plan: ReshardPlan, meta: dict) -> int:
+    """Integrity pass before any byte moves.  Sharded dirs: every blob
+    file intersecting a needed region must pass `_verify_blob` (size +
+    embedded sha256).  npz: re-hash the stored leaves against the tree
+    digest.  Returns the number of artifacts checked."""
+    if plan.npz:
+        digest = meta.get("digest")
+        if digest is None:
+            return 0  # digest-less legacy snapshot: nothing to check
+        with np.load(path, allow_pickle=False) as z:
+            paths = [rec["path"] for rec in meta["leaves"]]
+            leaves = [z[f"leaf_{i}"] for i in range(len(paths))]
+            if checkpoint._tree_digest(paths, leaves) != digest:
+                raise ValueError(
+                    f"{path} failed checksum validation (truncated or "
+                    "corrupt)"
+                )
+        return len(paths)
+    files: dict[tuple[int, str], tuple[Path, np.dtype]] = {}
+    for u in plan.units:
+        rec = meta["leaves"][u.leaf]
+        dtype = np.dtype(rec["dtype"])
+        for shard in rec["shards"]:
+            if _intersects(shard, u.bounds):
+                files[(u.leaf, shard["file"])] = (
+                    path / f"leaf_{u.leaf}" / shard["file"], dtype
+                )
+    for (leaf_i, name), (f, dtype) in sorted(files.items()):
+        if not checkpoint._verify_blob(f, dtype):
+            raise ValueError(
+                f"shard blob {f} failed integrity verification "
+                "(missing, truncated, or embedded-digest mismatch)"
+            )
+    return len(files)
+
+
+class _DirReader:
+    """Region reads from a sharded-dir source.  Holds exactly the bytes
+    of the in-flight region on the meter."""
+
+    def __init__(self, path: Path, meta: dict, meter):
+        self.path = path
+        self.meta = meta
+        self.meter = meter
+
+    def read(self, u: _Unit) -> np.ndarray:
+        rec = self.meta["leaves"][u.leaf]
+        self.meter.hold(u.nbytes)
+        return checkpoint._read_region(
+            self.path / f"leaf_{u.leaf}", rec, u.bounds,
+            np.dtype(rec["dtype"]),
+        )
+
+    def done(self, u: _Unit) -> None:
+        self.meter.release(u.nbytes)
+
+    def close(self) -> None:
+        pass
+
+
+class _NpzReader:
+    """Region reads from a monolithic npz source via a one-leaf
+    decompression cache (units arrive in leaf order, so each leaf is
+    decompressed exactly once; the cache bytes sit on the meter for the
+    leaf's lifetime and regions are served as views)."""
+
+    def __init__(self, path: Path, meta: dict, like_dtypes: list, meter):
+        self.z = np.load(path, allow_pickle=False)
+        self.meta = meta
+        self.like_dtypes = like_dtypes
+        self.meter = meter
+        self.cache_leaf: int | None = None
+        self.cache: np.ndarray | None = None
+
+    def _evict(self) -> None:
+        if self.cache is not None:
+            self.meter.release(self.cache.nbytes)
+            self.cache, self.cache_leaf = None, None
+
+    def read(self, u: _Unit) -> np.ndarray:
+        if self.cache_leaf != u.leaf:
+            self._evict()
+            arr = np.asarray(self.z[f"leaf_{u.leaf}"])
+            arr = _npz_dtype_view(arr, self.like_dtypes[u.leaf])
+            self.meter.hold(arr.nbytes)
+            self.cache, self.cache_leaf = arr, u.leaf
+        sel = tuple(slice(lo, hi) for lo, hi in u.bounds)
+        return self.cache[sel]
+
+    def done(self, u: _Unit) -> None:
+        pass  # cache-owned; released on evict/close
+
+    def close(self) -> None:
+        self._evict()
+        self.z.close()
+
+
+def _zero_leaf(tmpl: Any):
+    """Zero-initialized replacement for a shape-mismatched leaf, under
+    the template's target sharding (device leaves) or as numpy (host)."""
+    import jax
+
+    shape, dtype = _leaf_shape_dtype(tmpl)
+    sharding = _leaf_sharding(tmpl)
+    if sharding is None:
+        return np.zeros(shape, dtype)
+
+    def cb(index):
+        b = checkpoint._norm_index(index, shape)
+        return np.zeros(tuple(hi - lo for lo, hi in b), dtype)
+
+    return jax.make_array_from_callback(shape, sharding, cb)
+
+
+def redistribute(
+    path: str | Path,
+    like: Any,
+    *,
+    target_partition: dict | None = None,
+    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+    verify: bool = True,
+    on_shape_mismatch: str = "reset",
+    logger=None,
+    sampler=None,
+) -> tuple[Any, int]:
+    """Redistribute a saved checkpoint (sharded dir or npz, any source
+    mesh / rule set) onto the shardings of ``like`` — the elastic-resume
+    engine.  Returns ``(tree, step)`` like the restore functions.
+
+    ``like`` supplies structure, shapes, dtypes, AND target shardings
+    (live ``jax.Array`` state or `target_templates` output).  Peak
+    transient host bytes are hard-bounded at ``2 × largest bucket``
+    (`ReshardPlan.bound_bytes`) — exceeded is an error, not a warning.
+    ``target_partition`` (a `parallel.partition_summary`) is recorded in
+    the emitted ``reshard`` event next to the saved provenance.  A
+    failure in any phase raises `ReshardError` whose ``phase`` names the
+    dying phase, mirrored by the flight-ring trail."""
+    import jax
+
+    from tpu_dist.observe import events as ev_mod
+    from tpu_dist.observe import flightrec
+    from tpu_dist.observe import memory as mem_mod
+
+    path = Path(path)
+    ring = flightrec.get()
+    log = logger if logger is not None else ev_mod.from_env()
+    t0 = time.monotonic()
+    phase = "plan"
+    meter = None
+    plan = None
+
+    def _mark(p: str, **fields) -> None:
+        ring.record("mark", what="reshard", phase=p, path=str(path), **fields)
+
+    try:
+        _mark("plan")
+        meta, npz = _load_source_meta(path)
+        plan = _plan_from_meta(
+            path, meta, npz, like,
+            bucket_bytes=bucket_bytes, on_shape_mismatch=on_shape_mismatch,
+        )
+        if verify:
+            phase = "verify"
+            _mark("verify", units=len(plan.units))
+            _verify_source(path, plan, meta)
+        phase = "stream"
+        meter = mem_mod.TransientMeter(limit_bytes=plan.bound_bytes)
+        if sampler is None:
+            sampler = mem_mod.WatermarkSampler(flight=ring)
+        leaves_like, treedef = checkpoint._flatten_with_paths(like)
+        if plan.npz:
+            reader = _NpzReader(
+                path, meta,
+                [_leaf_shape_dtype(t)[1] for _, t in leaves_like], meter,
+            )
+        else:
+            reader = _DirReader(path, meta, meter)
+        out: dict[int, Any] = {
+            i: _zero_leaf(leaves_like[i][1]) for i in plan.reset_leaves
+        }
+        pending: dict[int, int] = {}
+        for u in plan.units:
+            pending[u.leaf] = pending.get(u.leaf, 0) + 1
+        placements: dict[int, list] = {}
+        try:
+            for b, bucket in enumerate(plan.buckets):
+                _mark("stream", bucket=b, units=len(bucket),
+                      bytes=sum(plan.units[j].nbytes for j in bucket))
+                for j in bucket:
+                    u = plan.units[j]
+                    region = reader.read(u)
+                    if u.devices:
+                        parts = placements.setdefault(u.leaf, [])
+                        for dev in u.devices:
+                            parts.append(jax.device_put(region, dev))
+                    else:
+                        # Host leaf: the assembled region IS the output
+                        # (copy out of the npz cache — views die on
+                        # evict), committed, no longer transient.
+                        out[u.leaf] = (
+                            np.array(region) if plan.npz else region
+                        )
+                    reader.done(u)
+                    pending[u.leaf] -= 1
+                    if pending[u.leaf] == 0 and u.leaf in placements:
+                        tmpl = leaves_like[u.leaf][1]
+                        shape, _ = _leaf_shape_dtype(tmpl)
+                        out[u.leaf] = (
+                            jax.make_array_from_single_device_arrays(
+                                shape, _leaf_sharding(tmpl),
+                                placements.pop(u.leaf),
+                            )
+                        )
+                sampler.sample("reshard")
+        finally:
+            reader.close()
+        phase = "commit"
+        _mark("commit", leaves=len(leaves_like))
+        if len(out) != len(leaves_like):
+            missing = [
+                kp for i, (kp, _) in enumerate(leaves_like) if i not in out
+            ]
+            raise ValueError(
+                f"redistribution left {len(missing)} leaf/leaves "
+                f"unassembled (e.g. {missing[0]})"
+            )
+        tree = jax.tree_util.tree_unflatten(
+            treedef, [out[i] for i in range(len(leaves_like))]
+        )
+        seconds = time.monotonic() - t0
+        log.emit(
+            "reshard",
+            source=plan.source,
+            target=target_partition,
+            bytes_moved=plan.bytes_to_move,
+            peak_bytes=meter.peak,
+            seconds=seconds,
+            status="ok",
+            step=plan.step,
+            path=str(path),
+            units=len(plan.units),
+            buckets=len(plan.buckets),
+            bound_bytes=plan.bound_bytes,
+            leaves_reset=sorted(plan.reset_leaves.values()),
+            watermark=sampler.summary(),
+        )
+        _mark("done", seconds=seconds, bytes_moved=plan.bytes_to_move,
+              peak_bytes=meter.peak)
+        return tree, plan.step
+    except ReshardError:
+        raise
+    except Exception as e:
+        _mark("failed", failed_phase=phase, error=f"{type(e).__name__}: {e}")
+        try:
+            log.emit(
+                "reshard",
+                source=plan.source if plan is not None else None,
+                target=target_partition,
+                bytes_moved=plan.bytes_to_move if plan is not None else 0,
+                peak_bytes=meter.peak if meter is not None else 0,
+                seconds=time.monotonic() - t0,
+                status="failed",
+                failed_phase=phase,
+                error=f"{type(e).__name__}: {e}",
+                path=str(path),
+            )
+        except Exception:
+            pass  # telemetry must not mask the real failure
+        raise ReshardError(
+            f"redistribution of {path} failed in phase {phase!r}: {e}",
+            phase=phase,
+        ) from e
+
+
+def target_templates(like: Any, rules, mesh) -> Any:
+    """Template tree for `redistribute`: shapes/dtypes from ``like``
+    (live arrays, numpy, or `jax.ShapeDtypeStruct`s), target shardings
+    from matching ``rules`` (a rule iterable or a `parallel.RuleSet`,
+    whose param rules are used) on the TARGET mesh."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    rules = getattr(rules, "param_rules", rules)
+    from tpu_dist.parallel.partition import match_partition_rules
+
+    specs = match_partition_rules(rules, like, mesh)
+
+    def to_tmpl(leaf, spec):
+        shape, dtype = _leaf_shape_dtype(leaf)
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=NamedSharding(mesh, spec)
+        )
+
+    return jax.tree_util.tree_map(to_tmpl, like, specs)
+
+
+def restore_or_redistribute(
+    path: str | Path,
+    like: Any,
+    expected_partition: dict,
+    *,
+    where: str = "restore",
+    logger=None,
+) -> tuple[Any, int, bool]:
+    """The engine trainers' resume route.  Compatible provenance
+    (identical, or a same-rules/same-axes world resize) takes the direct
+    `checkpoint.restore_fsdp` path; any rule-set or topology change is
+    redistributed onto ``like``'s shardings.  Returns
+    ``(tree, step, resharded)``."""
+    path = Path(path)
+    meta = checkpoint.read_meta(path) if path.is_dir() else \
+        _load_source_meta(path)[0]
+    if checkpoint.partition_mismatch(meta, expected_partition, where=where):
+        tree, step = redistribute(
+            path, like, target_partition=expected_partition, logger=logger
+        )
+        return tree, step, True
+    tree, step = checkpoint.restore_fsdp(path, like)
+    return tree, step, False

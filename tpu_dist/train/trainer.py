@@ -430,15 +430,21 @@ class Trainer:
         like = self._ckpt_tree()
         if self._sharded_ckpt:
             if self._ruleset is not None:
-                # Engine mode: a checkpoint from a different rule set or
-                # mesh must fail loudly, not flat-copy into garbage.
-                checkpoint.check_partition(
-                    checkpoint.read_meta(path), self._partition_meta,
+                # Engine mode: elastic resume.  Compatible provenance
+                # (identical, or a same-rules world resize) restores
+                # directly; a different rule set or topology is
+                # redistributed onto this run's shardings in
+                # memory-bounded buckets (train.reshard).
+                from tpu_dist.train import reshard as reshard_mod
+
+                restored, epoch, _ = reshard_mod.restore_or_redistribute(
+                    path, like, self._partition_meta,
                     where=f"restore({path})",
                 )
-            # Rebuilt under the templates' shardings — replicated leaves
-            # come back replicated, the EF residual comes back P(data).
-            restored, epoch = checkpoint.restore_fsdp(path, like)
+            else:
+                # Rebuilt under the templates' shardings — replicated
+                # leaves come back replicated, fsdp leaves row-sharded.
+                restored, epoch = checkpoint.restore_fsdp(path, like)
             self.params = restored["params"]
             # A checkpoint from a DIFFERENT world size flat-copies fsdp
             # rows validly (zero padding) but would misdirect the dense
